@@ -1,0 +1,30 @@
+"""attackfl_tpu — a TPU-native federated-learning poisoning-attack framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference FL
+poisoning testbed (``filrg/attackFL``).  Where the reference runs one OS
+process per client and ships pickled tensors through RabbitMQ
+(reference: server.py:100-185, src/RpcClient.py:16-188), this framework
+runs the *entire* federation in-process on a TPU mesh:
+
+* Clients are a **leading pytree axis** — N client replicas stacked into one
+  parameter pytree, locally trained with ``jax.vmap`` and sharded across
+  devices with ``jax.sharding`` / ``shard_map`` over a ``clients`` mesh axis.
+* "Broadcast" is sharding-implied replication, "collect + aggregate" is a
+  reduction along the client axis compiled to XLA collectives over ICI —
+  there is no broker, no serialization, no pickle in the hot path.
+* Attacks (Random / LIE / Min-Max / Min-Sum / Opt-Fang) are pure tensor
+  programs over the stacked genuine updates (``lax.while_loop`` for the
+  γ-searches), and aggregation defenses (FedAvg, median, trimmed-mean,
+  Krum, ShieldFL, ScionFL, FLTrust, GMM filter, FLTracer, hypernetwork
+  personalization) are pure functions from (stacked params, sizes) to a
+  global pytree.
+
+Public API mirrors the reference's surface (config.yaml schema, model
+registry keyed by class name, CLI launchers) while replacing its transport
+and execution model wholesale.
+"""
+
+__version__ = "0.1.0"
+
+from attackfl_tpu.config import Config, load_config  # noqa: F401
+from attackfl_tpu.registry import get_model, register_model, MODEL_REGISTRY  # noqa: F401

@@ -1,0 +1,5 @@
+from attackfl_tpu.data.synthetic import make_dataset  # noqa: F401
+from attackfl_tpu.data.partition import (  # noqa: F401
+    sample_round_indices,
+    dirichlet_label_partition,
+)

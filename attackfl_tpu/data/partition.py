@@ -1,0 +1,87 @@
+"""Per-round client data assignment.
+
+The reference's data distribution is *quantity skew over a shared pool*:
+every round, every client independently draws ``num_data ~ U[lo, hi]``
+fresh samples from the full shared train set (src/RpcClient.py:97,166-169).
+Under jit/vmap all shapes must be static, so this becomes: every client
+gets a padded index matrix of shape (hi,) plus a validity mask — gathers
+stay fixed-shape, the weighted aggregation uses the true sizes.
+
+Additionally a Dirichlet non-IID *label* partition is provided (BASELINE
+config 3 requires a non-IID split the reference does not implement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sample_round_indices(
+    rng: jax.Array,
+    num_clients: int,
+    pool_size: int,
+    lo: int,
+    hi: int,
+    client_pools: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Draw per-client padded sample indices for one round.
+
+    Returns ``(indices (C, hi) int32, mask (C, hi) bool, sizes (C,) int32)``.
+    ``sizes[c] ~ U[lo, hi]`` inclusive, matching the reference's
+    ``random.randrange(lo, hi + 1)`` (src/RpcClient.py:97).  Indices are
+    drawn uniformly *with replacement* from the pool — the reference uses
+    ``random.sample`` (without replacement); with pool sizes ≫ num_data the
+    difference is statistically negligible and with-replacement keeps the
+    sampler O(hi) and shape-static on device.
+
+    If ``client_pools`` (C, pool_size) is given (non-IID partition), each
+    row holds the client's own permitted indices (padded by repetition) and
+    sampling gathers from that row instead of the global range.
+    """
+    k_size, k_idx = jax.random.split(rng)
+    sizes = jax.random.randint(k_size, (num_clients,), lo, hi + 1)
+    if client_pools is not None:
+        slot = jax.random.randint(k_idx, (num_clients, hi), 0, client_pools.shape[1])
+        idx = jnp.take_along_axis(client_pools, slot, axis=1)
+    else:
+        idx = jax.random.randint(k_idx, (num_clients, hi), 0, pool_size)
+    mask = jnp.arange(hi)[None, :] < sizes[:, None]
+    return idx.astype(jnp.int32), mask, sizes.astype(jnp.int32)
+
+
+def dirichlet_label_partition(
+    labels: np.ndarray,
+    num_clients: int,
+    alpha: float,
+    seed: int = 0,
+) -> np.ndarray:
+    """Non-IID label split: per-class Dirichlet(alpha) proportions over
+    clients (the standard Hsu et al. 2019 protocol).
+
+    Returns an int32 matrix (num_clients, pool) where row c lists the
+    sample indices client c may draw from, padded by repetition to equal
+    length so it can live on device as one array.
+    """
+    rng = np.random.default_rng(seed)
+    labels = np.asarray(labels).astype(np.int64)
+    classes = np.unique(labels)
+    client_indices: list[list[int]] = [[] for _ in range(num_clients)]
+    for cls in classes:
+        cls_idx = np.flatnonzero(labels == cls)
+        rng.shuffle(cls_idx)
+        props = rng.dirichlet(np.full(num_clients, alpha))
+        cuts = (np.cumsum(props) * len(cls_idx)).astype(int)[:-1]
+        for c, part in enumerate(np.split(cls_idx, cuts)):
+            client_indices[c].extend(part.tolist())
+    # Guarantee non-empty pools, then pad by repetition to a rectangle.
+    for c in range(num_clients):
+        if not client_indices[c]:
+            client_indices[c].append(int(rng.integers(len(labels))))
+    width = max(len(ci) for ci in client_indices)
+    out = np.zeros((num_clients, width), dtype=np.int32)
+    for c, ci in enumerate(client_indices):
+        reps = -(-width // len(ci))
+        out[c] = np.tile(np.asarray(ci, dtype=np.int32), reps)[:width]
+    return out

@@ -1,0 +1,120 @@
+"""Datasets.
+
+The reference trains on gzip-pickled pandas/torch datasets whose blobs are
+absent from its repo (reference: .MISSING_LARGE_BLOBS; loaders at
+src/RpcClient.py:155-164 and src/Validation.py:32-48).  This module
+provides (a) deterministic synthetic generators with the same shapes and
+label semantics so every config is runnable end-to-end, and (b) a loader
+for the reference's gzip-pickle format when real blobs exist.
+
+Arrays are plain dict-of-ndarray "struct of arrays" — no Dataset objects,
+no per-item __getitem__: batches are gathers on device.
+
+Shapes:
+  ICU:     vitals (N, 7) float32, labs (N, 16) float32, label (N,) {0,1}
+           (dual-branch models, src/Model.py:95; ~mortality base rate .25)
+  HAR:     x (N, 561) float32, label (N,) in 0..5 (src/Model.py:435-453)
+  CIFAR10: x (N, 32, 32, 3) float32 normalized to [-1, 1], label (N,) 0..9
+           (torchvision normalize (0.5,0.5,0.5), Validation.py:39-42)
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+Batch = dict[str, np.ndarray]
+
+
+def _icu(rng: np.random.Generator, n: int) -> Batch:
+    """Synthetic ICU cohort: labels depend on a sparse linear risk score of
+    vitals+labs through a logistic link, so models can reach AUC >> 0.5."""
+    vitals = rng.normal(0.0, 1.0, size=(n, 7)).astype(np.float32)
+    labs = rng.normal(0.0, 1.0, size=(n, 16)).astype(np.float32)
+    # fixed ground-truth weights (same for every call at a given seed policy)
+    w_rng = np.random.default_rng(7)
+    wv = w_rng.normal(0, 1, size=(7,))
+    wl = w_rng.normal(0, 1, size=(16,))
+    score = vitals @ wv + labs @ wl
+    prob = 1.0 / (1.0 + np.exp(-(score - 1.0)))  # ~25% positive rate
+    label = (rng.uniform(size=n) < prob).astype(np.float32)
+    # sprinkle the reference's mask value into vitals (missing measurements;
+    # RNNModel zeroes them, src/Model.py:98,122)
+    mask = rng.uniform(size=vitals.shape) < 0.05
+    vitals = np.where(mask, np.float32(-2.0), vitals)
+    return {"vitals": vitals, "labs": labs, "label": label}
+
+
+def _har(rng: np.random.Generator, n: int) -> Batch:
+    """Synthetic HAR: 6 activity classes, each a distinct smooth template
+    over 561 pseudo-features plus noise."""
+    t = np.linspace(0.0, 6.0 * np.pi, 561)
+    templates = np.stack(
+        [np.sin((k + 1) * 0.5 * t + k) * (1.0 + 0.1 * k) for k in range(6)]
+    ).astype(np.float32)  # (6, 561)
+    label = rng.integers(0, 6, size=n)
+    x = templates[label] + rng.normal(0, 0.5, size=(n, 561)).astype(np.float32)
+    return {"x": x.astype(np.float32), "label": label.astype(np.int32)}
+
+
+def _cifar10(rng: np.random.Generator, n: int) -> Batch:
+    """Synthetic CIFAR-10 stand-in: class-conditional colored blobs."""
+    label = rng.integers(0, 10, size=n)
+    base = np.random.default_rng(11).uniform(-0.6, 0.6, size=(10, 1, 1, 3)).astype(np.float32)
+    x = base[label] + rng.normal(0, 0.3, size=(n, 32, 32, 3)).astype(np.float32)
+    return {"x": np.clip(x, -1, 1).astype(np.float32), "label": label.astype(np.int32)}
+
+
+_GENERATORS = {"ICU": _icu, "HAR": _har, "CIFAR10": _cifar10}
+
+
+def make_dataset(data_name: str, n: int, seed: int = 0) -> Batch:
+    if data_name not in _GENERATORS:
+        raise ValueError(f"Data name '{data_name}' is not valid.")
+    return _GENERATORS[data_name](np.random.default_rng(seed), n)
+
+
+def load_reference_pickle(path: str) -> Batch:
+    """Load a reference-format gzip-pickled dataset if present
+    (``train_dataset.pkl.gz`` / ``data/icu_har_*.pkl.gz``,
+    src/RpcClient.py:157-162).  The pickle holds a torch Dataset; we
+    convert to the struct-of-arrays layout."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with gzip.open(path, "rb") as fh:
+        ds: Any = pickle.load(fh)
+    first = ds[0]
+    if isinstance(first, (tuple, list)) and len(first) == 3:  # ICU (vitals, labs, label)
+        cols = list(zip(*(ds[i] for i in range(len(ds)))))
+        return {
+            "vitals": np.stack([np.asarray(v) for v in cols[0]]).astype(np.float32),
+            "labs": np.stack([np.asarray(v) for v in cols[1]]).astype(np.float32),
+            "label": np.asarray(cols[2], dtype=np.float32),
+        }
+    if isinstance(first, (tuple, list)) and len(first) == 2:  # HAR (x, label)
+        cols = list(zip(*(ds[i] for i in range(len(ds)))))
+        x = np.stack([np.asarray(v) for v in cols[0]]).astype(np.float32)
+        if x.ndim == 3 and x.shape[1] == 1:
+            x = x[:, 0, :]
+        return {"x": x, "label": np.asarray(cols[1], dtype=np.int32)}
+    raise ValueError(f"Unrecognized reference dataset format in {path}")
+
+
+def get_dataset(data_name: str, split: str, size: int, seed: int) -> Batch:
+    """Reference-compatible entry point: try the reference's pickle paths
+    first, fall back to synthetic data."""
+    paths = {
+        ("ICU", "train"): "train_dataset.pkl.gz",
+        ("ICU", "test"): "data/test_dataset.pkl.gz",
+        ("HAR", "train"): "data/icu_har_train_ds.pkl.gz",
+        ("HAR", "test"): "data/icu_har_test_ds.pkl.gz",
+    }
+    path = paths.get((data_name, split))
+    if path and os.path.exists(path):
+        return load_reference_pickle(path)
+    # seeds: train/test splits must be disjoint
+    return make_dataset(data_name, size, seed=seed + (0 if split == "train" else 10_000))

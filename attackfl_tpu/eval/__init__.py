@@ -1,0 +1,1 @@
+from attackfl_tpu.eval.validation import Validation, roc_auc  # noqa: F401

@@ -1,0 +1,168 @@
+"""Server-side validation: the round-acceptance gate.
+
+Parity with the reference's Validation subsystem (src/Validation.py:19-214):
+ICU rounds are scored by ROC-AUC and fail on NaN outputs; HAR by accuracy;
+CIFAR10 by NLL + accuracy failing on NaN or |loss| > 1e6; hyper mode pools
+every client's personalized outputs into one AUC.  Unlike the reference
+(batched torch loops on host), evaluation here is a single jitted forward
+over the device-resident test set, including a jit-compatible tie-aware
+ROC-AUC (no sklearn).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Batch = dict[str, jnp.ndarray]
+
+
+def roc_auc(labels: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+    """Area under the ROC curve, tie-aware, fully on-device.
+
+    Uses the rank-statistic identity AUC = (Σ ranks⁺ − n⁺(n⁺+1)/2)/(n⁺ n⁻)
+    with average ranks for tied scores — identical to trapezoidal
+    integration over the tie-grouped ROC curve (what sklearn's
+    roc_curve/auc computes for the reference, src/Validation.py:116-117).
+    """
+    labels = labels.reshape(-1)
+    scores = scores.reshape(-1)
+    sorted_scores = jnp.sort(scores)
+    left = jnp.searchsorted(sorted_scores, scores, side="left")
+    right = jnp.searchsorted(sorted_scores, scores, side="right")
+    avg_rank = (left + right + 1).astype(jnp.float32) / 2.0  # 1-based average ranks
+    n_pos = jnp.sum(labels)
+    n_neg = labels.shape[0] - n_pos
+    rank_sum = jnp.sum(jnp.where(labels > 0.5, avg_rank, 0.0))
+    return (rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+
+
+def _forward_in_chunks(apply_fn: Callable, data: Batch, chunk: int = 4096):
+    """Evaluate in fixed-size chunks to bound activation memory; the test
+    set is padded to a multiple of the chunk size."""
+    n = next(iter(data.values())).shape[0]
+    num_chunks = -(-n // chunk)
+    pad = num_chunks * chunk - n
+    padded = {k: jnp.concatenate([v, jnp.repeat(v[:1], pad, axis=0)], axis=0) if pad else v
+              for k, v in data.items()}
+    chunks = {k: v.reshape((num_chunks, chunk) + v.shape[1:]) for k, v in padded.items()}
+    outs = jax.lax.map(apply_fn, chunks)
+    outs = outs.reshape((num_chunks * chunk,) + outs.shape[2:])
+    return outs[:n]
+
+
+def evaluate_icu(model, params: Any, test_data: Batch) -> dict[str, jnp.ndarray]:
+    """ROC-AUC over the ICU test set; ok=False on NaN outputs
+    (reference: test_icu, src/Validation.py:92-122)."""
+    probs = _forward_in_chunks(
+        lambda b: model.apply({"params": params}, b["vitals"], b["labs"])[:, 0],
+        test_data,
+    )
+    ok = ~jnp.any(jnp.isnan(probs))
+    auc_val = roc_auc(test_data["label"], probs)
+    return {"roc_auc": auc_val, "ok": ok, "metric": auc_val}
+
+
+def evaluate_har(model, params: Any, test_data: Batch) -> dict[str, jnp.ndarray]:
+    """Accuracy over the HAR test set (reference: test_har,
+    src/Validation.py:124-136 — always passes the round)."""
+    logits = _forward_in_chunks(
+        lambda b: model.apply({"params": params}, b["x"]), test_data
+    )
+    acc = jnp.mean((jnp.argmax(logits, axis=-1) == test_data["label"]).astype(jnp.float32))
+    return {"accuracy": acc, "ok": jnp.asarray(True), "metric": acc}
+
+
+def evaluate_cifar(model, params: Any, test_data: Batch) -> dict[str, jnp.ndarray]:
+    """Mean NLL + accuracy; fails on NaN or |loss| > 1e6
+    (reference: test_image, src/Validation.py:69-90)."""
+    logp = _forward_in_chunks(
+        lambda b: model.apply({"params": params}, b["x"]), test_data
+    )
+    nll = -jnp.take_along_axis(logp, test_data["label"][:, None], axis=1)[:, 0]
+    loss = jnp.mean(nll)
+    acc = jnp.mean((jnp.argmax(logp, axis=-1) == test_data["label"]).astype(jnp.float32))
+    ok = jnp.isfinite(loss) & (jnp.abs(loss) <= 1e6)
+    return {"nll": loss, "accuracy": acc, "ok": ok, "metric": acc}
+
+
+def evaluate_hyper_icu(model, stacked_params: Any, test_data: Batch) -> dict[str, jnp.ndarray]:
+    """Hyper-mode ICU validation: every client's personalized model runs the
+    full test set and ALL outputs pool into one ROC-AUC
+    (reference: test_hyper_icu, src/Validation.py:178-214)."""
+
+    def one_client(params):
+        return _forward_in_chunks(
+            lambda b: model.apply({"params": params}, b["vitals"], b["labs"])[:, 0],
+            test_data,
+        )
+
+    probs = jax.lax.map(one_client, stacked_params)  # (C, N)
+    ok = ~jnp.any(jnp.isnan(probs))
+    n_clients = probs.shape[0]
+    labels = jnp.tile(test_data["label"], n_clients)
+    auc_val = roc_auc(labels, probs.reshape(-1))
+    return {"roc_auc": auc_val, "ok": ok, "metric": auc_val}
+
+
+def evaluate_hyper_cifar(model, stacked_params: Any, test_data: Batch) -> dict[str, jnp.ndarray]:
+    """Hyper-mode CIFAR validation: per-client personalized models over the
+    full test set, losses/accuracy pooled (reference: test_hyper_image,
+    src/Validation.py:147-176)."""
+
+    def one_client(params):
+        return _forward_in_chunks(
+            lambda b: model.apply({"params": params}, b["x"]), test_data
+        )
+
+    logp = jax.lax.map(one_client, stacked_params)  # (C, N, 10)
+    nll = -jnp.take_along_axis(logp, test_data["label"][None, :, None], axis=2)[..., 0]
+    loss = jnp.mean(nll)
+    acc = jnp.mean((jnp.argmax(logp, axis=-1) == test_data["label"][None, :]).astype(jnp.float32))
+    ok = jnp.isfinite(loss) & (jnp.abs(loss) <= 1e6)
+    return {"nll": loss, "accuracy": acc, "ok": ok, "metric": acc}
+
+
+_EVALUATORS = {"ICU": evaluate_icu, "HAR": evaluate_har, "CIFAR10": evaluate_cifar}
+_HYPER_EVALUATORS = {"ICU": evaluate_hyper_icu, "CIFAR10": evaluate_hyper_cifar}
+
+
+class Validation:
+    """Object-style wrapper mirroring the reference's ``Validation`` class
+    surface (``test``/``test_hyper``, src/Validation.py:19-214), with jitted
+    evaluators underneath."""
+
+    def __init__(self, model, data_name: str, test_data: Batch, logger=None):
+        if data_name not in _EVALUATORS:
+            raise ValueError(f"Data name '{data_name}' is not valid.")
+        self.data_name = data_name
+        self.logger = logger
+        self.test_data = {k: jnp.asarray(v) for k, v in test_data.items()}
+        self._eval = jax.jit(partial(_EVALUATORS[data_name], model, test_data=self.test_data))
+        if data_name in _HYPER_EVALUATORS:
+            self._eval_hyper = jax.jit(
+                partial(_HYPER_EVALUATORS[data_name], model, test_data=self.test_data)
+            )
+        else:
+            self._eval_hyper = None  # HAR has no hyper eval (reference: Validation.py:138-145)
+
+    def test(self, params: Any) -> tuple[bool, dict[str, float]]:
+        out = {k: np.asarray(v) for k, v in self._eval(params).items()}
+        ok = bool(out.pop("ok"))
+        metrics = {k: float(v) for k, v in out.items()}
+        if self.logger:
+            self.logger.log_info(
+                " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+            )
+        return ok, metrics
+
+    def test_hyper(self, stacked_params: Any) -> tuple[bool, dict[str, float]]:
+        if self._eval_hyper is None:
+            raise ValueError(f"Not found hyper test function for data name {self.data_name}")
+        out = {k: np.asarray(v) for k, v in self._eval_hyper(stacked_params).items()}
+        ok = bool(out.pop("ok"))
+        return ok, {k: float(v) for k, v in out.items()}

@@ -1,0 +1,7 @@
+"""Model zoo.  Importing this package registers every model under its
+reference class name (registry contract: see attackfl_tpu/registry.py)."""
+
+from attackfl_tpu.models.icu import CNNModel, RNNModel, TransformerModel  # noqa: F401
+from attackfl_tpu.models.har import TransformerClassifier  # noqa: F401
+from attackfl_tpu.models.hyper import HyperNetwork, make_hypernetwork, target_spec  # noqa: F401
+from attackfl_tpu.models.resnet import ResNet18  # noqa: F401

@@ -1,0 +1,48 @@
+"""HAR (human activity recognition) classifier: Conv stem + sinusoidal
+positional encoding + 2-layer Transformer encoder + mean pool, 6 classes
+(reference: src/Model.py:420-458).
+
+Input: (B, 561) feature signal (or (B, 1, 561) torch layout, accepted for
+compat).  Output: (B, 6) logits.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from attackfl_tpu.models.layers import TorchEncoderLayer, sinusoidal_position_encoding
+from attackfl_tpu.registry import register_model
+
+
+@register_model("TransformerClassifier")
+class TransformerClassifier(nn.Module):
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    num_classes: int = 6
+    ff_dim: int = 256
+    dropout_rate: float = 0.1
+    max_len: int = 600
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        det = not train
+        if x.ndim == 3:  # (B, 1, L) torch channel-first layout
+            x = x[:, 0, :]
+        x = x[..., None]  # (B, L, 1): NLC
+        x = nn.Conv(self.d_model, (3,), padding="SAME", name="conv")(x)  # (B, L, d)
+        pe = sinusoidal_position_encoding(self.max_len, self.d_model)
+        x = x + pe[None, : x.shape[1], :]
+        for i in range(self.num_layers):
+            x = TorchEncoderLayer(
+                self.d_model,
+                self.num_heads,
+                self.ff_dim,
+                self.dropout_rate,
+                name=f"encoder{i}",
+            )(x, deterministic=det)
+        x = jnp.mean(x, axis=1)  # global average pool over sequence
+        x = nn.relu(nn.Dense(64, name="cls_dense1")(x))
+        x = nn.Dropout(0.3, deterministic=det)(x)
+        return nn.Dense(self.num_classes, name="cls_dense2")(x)

@@ -1,0 +1,106 @@
+"""Hypernetwork for personalized FL (pFedHN-style "hyper" server mode).
+
+Re-design of the reference's generic HyperNetwork (src/Model.py:251-304):
+a per-client embedding table feeding an MLP trunk whose features are mapped
+by one linear head per *target-parameter leaf* into a full parameter pytree
+for the target model.  The reference keys heads by sanitized state_dict
+names (``create_hyper_layers``, src/Model.py:268-283); here heads are keyed
+by the flattened path of the Flax param tree, and a factory closes over the
+target template so callers get real parameter pytrees back.
+
+The server-side update is the reference's
+``torch.autograd.grad(outputs=weights, grad_outputs=delta_theta)``
+(server.py:654-659) — which in JAX is literally ``jax.vjp`` applied to the
+cotangent ``delta_theta`` (see training/hyper.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _path_name(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def target_spec(template_params: Any) -> tuple[tuple[str, tuple[int, ...]], ...]:
+    """Hashable (name, shape) spec for every leaf of a target param pytree."""
+    flat = jax.tree_util.tree_flatten_with_path(template_params)[0]
+    return tuple((_path_name(p).replace("/", "__"), tuple(leaf.shape)) for p, leaf in flat)
+
+
+class HyperNetwork(nn.Module):
+    """Embedding(n_nodes, embedding_dim) -> MLP(hidden_dim, n_hidden) ->
+    one Dense head per target leaf (reference: src/Model.py:251-304,
+    instantiated ``HyperNetwork(net, total_clients, 8, 100, False, 2)`` at
+    server.py:800).
+
+    ``__call__(idx)`` with a scalar int index returns
+    ``(flat_outputs: dict[name, array(shape)], embedding: (embedding_dim,))``.
+    """
+
+    spec: tuple[tuple[str, tuple[int, ...]], ...]
+    n_nodes: int
+    embedding_dim: int = 8
+    hidden_dim: int = 100
+    spec_norm: bool = False
+    n_hidden: int = 2
+
+    @nn.compact
+    def __call__(self, idx: jnp.ndarray):
+        if self.spec_norm:
+            raise NotImplementedError(
+                "spectral-norm hypernetwork heads are not implemented; the "
+                "reference always instantiates with spec_norm=False "
+                "(server.py:800)"
+            )
+        emd = nn.Embed(self.n_nodes, self.embedding_dim, name="embeddings")(idx)
+        f = nn.Dense(self.hidden_dim, name="mlp_in")(emd)
+        for i in range(self.n_hidden):
+            f = nn.Dense(self.hidden_dim, name=f"mlp_hidden{i}")(nn.relu(f))
+
+        outputs: dict[str, jnp.ndarray] = {}
+        for name, shape in self.spec:
+            numel = math.prod(shape) if shape else 1
+            out = nn.Dense(numel, name=f"head_{name}")(f)
+            outputs[name] = out.reshape(shape)
+        return outputs, emd
+
+
+def make_hypernetwork(
+    template_params: Any,
+    n_nodes: int,
+    embedding_dim: int = 8,
+    hidden_dim: int = 100,
+    spec_norm: bool = False,
+    n_hidden: int = 2,
+) -> tuple[HyperNetwork, Callable]:
+    """Build a HyperNetwork for a target param pytree.
+
+    Returns ``(module, apply_fn)`` where
+    ``apply_fn(hparams, idx) -> (target_params_pytree, embedding)``
+    reconstructs the full target structure from the flat head outputs.
+    """
+    spec = target_spec(template_params)
+    module = HyperNetwork(
+        spec=spec,
+        n_nodes=n_nodes,
+        embedding_dim=embedding_dim,
+        hidden_dim=hidden_dim,
+        spec_norm=spec_norm,
+        n_hidden=n_hidden,
+    )
+    treedef = jax.tree.structure(template_params)
+    names = [name for name, _ in spec]
+
+    def apply_fn(hparams, idx):
+        flat, emd = module.apply({"params": hparams}, idx)
+        params = jax.tree.unflatten(treedef, [flat[n] for n in names])
+        return params, emd
+
+    return module, apply_fn

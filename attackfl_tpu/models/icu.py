@@ -1,0 +1,142 @@
+"""ICU mortality models: dual-branch (vitals 7-dim, labs 16-dim) binary
+classifiers, architecture-parity rebuilds of the reference models
+(src/Model.py:27-246) in Flax.
+
+All models take ``(vitals (B,7), labs (B,16))`` and return sigmoid
+probabilities of shape (B, 1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from attackfl_tpu.models.layers import TransformerBlock, adaptive_avg_pool1d
+from attackfl_tpu.registry import register_model
+
+
+@register_model("CNNModel")
+class CNNModel(nn.Module):
+    """Dual-branch 1-D CNN (reference: src/Model.py:27-88).
+
+    Per branch: the feature vector is treated as a 1-channel signal,
+    3x Conv1d(k=3, same) with channels 32 -> 64 -> 128 + ReLU, adaptive
+    average pool to 4 positions, flatten, dropout 0.3.  Merged through
+    FC 1024 -> 128 -> 64 -> 32 -> 1 with sigmoid.
+    """
+
+    dropout_rate: float = 0.3
+
+    def _branch(self, x: jnp.ndarray, prefix: str, deterministic: bool) -> jnp.ndarray:
+        x = x[..., None]  # (B, L) -> (B, L, 1): NLC layout
+        x = nn.relu(nn.Conv(32, (3,), padding="SAME", name=f"{prefix}_conv1")(x))
+        x = nn.relu(nn.Conv(64, (3,), padding="SAME", name=f"{prefix}_conv2")(x))
+        x = nn.relu(nn.Conv(128, (3,), padding="SAME", name=f"{prefix}_conv3")(x))
+        x = adaptive_avg_pool1d(x, 4)  # (B, 4, 128)
+        x = x.reshape(x.shape[0], -1)  # (B, 512)
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return x
+
+    @nn.compact
+    def __call__(self, vitals: jnp.ndarray, labs: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        det = not train
+        v = self._branch(vitals, "vitals", det)
+        l = self._branch(labs, "labs", det)
+        x = jnp.concatenate([v, l], axis=1)  # (B, 1024)
+        x = nn.relu(nn.Dense(128, name="fc1")(x))
+        x = nn.relu(nn.Dense(64, name="fc2")(x))
+        x = nn.relu(nn.Dense(32, name="fc3")(x))
+        return nn.sigmoid(nn.Dense(1, name="output")(x))
+
+
+class _BiGRUStack(nn.Module):
+    """Three stacked bidirectional GRUs, hidden size ``hidden`` each
+    direction (reference: src/Model.py:102-104)."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(3):
+            x = nn.Bidirectional(
+                nn.RNN(nn.GRUCell(self.hidden), name=f"fwd{i}"),
+                nn.RNN(nn.GRUCell(self.hidden), name=f"bwd{i}"),
+                name=f"bigru{i}",
+            )(x)
+        return x  # (B, T, 2*hidden)
+
+
+@register_model("RNNModel")
+class RNNModel(nn.Module):
+    """Dual-branch 3-layer bidirectional GRU model
+    (reference: src/Model.py:91-163).
+
+    Inputs equal to the mask value (-2.0) are zeroed; 2-D inputs gain a
+    singleton time axis; the last timestep is taken, LayerNorm'd and
+    dropped out per branch; merged through FC (4h -> h -> h/2 -> 1),
+    sigmoid.
+    """
+
+    vitals_input_dim: int = 7
+    labs_input_dim: int = 16
+    hidden_dim: int = 32
+    dropout_rate: float = 0.3
+    mask_value: float = -2.0
+
+    def _branch(self, x: jnp.ndarray, prefix: str, deterministic: bool) -> jnp.ndarray:
+        x = jnp.where(x == self.mask_value, jnp.zeros_like(x), x)
+        if x.ndim == 2:
+            x = x[:, None, :]  # (B, 1, F)
+        x = _BiGRUStack(self.hidden_dim, name=f"{prefix}_gru")(x)
+        x = x[:, -1, :]  # last timestep, (B, 2h)
+        x = nn.LayerNorm(name=f"{prefix}_ln")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return x
+
+    @nn.compact
+    def __call__(self, vitals: jnp.ndarray, labs: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        det = not train
+        v = self._branch(vitals, "vitals", det)
+        l = self._branch(labs, "labs", det)
+        x = jnp.concatenate([v, l], axis=1)  # (B, 4h)
+        x = nn.relu(nn.Dense(self.hidden_dim, name="fc1")(x))
+        x = nn.relu(nn.Dense(self.hidden_dim // 2, name="fc2")(x))
+        return nn.sigmoid(nn.Dense(1, name="output")(x))
+
+
+@register_model("TransformerModel")
+class TransformerModel(nn.Module):
+    """Dual-branch single-block Transformer (reference: src/Model.py:194-246;
+    the config.yaml default model).
+
+    Per branch: Dense(F -> 64) + GELU, one TransformerBlock (4 heads,
+    ff_dim 6) over a singleton sequence, LayerNorm.  Merged through
+    FC 128 -> 64 (GELU, dropout 0.3) -> 32 (GELU) -> 1, sigmoid.
+    """
+
+    vitals_input_dim: int = 7
+    labs_input_dim: int = 16
+    num_heads: int = 4
+    ff_dim: int = 6
+    dropout_rate: float = 0.3
+
+    def _branch(self, x: jnp.ndarray, prefix: str, deterministic: bool) -> jnp.ndarray:
+        x = nn.gelu(nn.Dense(64, name=f"{prefix}_dense")(x))
+        x = x[:, None, :]  # seq len 1 (reference unsqueezes, Model.py:227)
+        x = TransformerBlock(
+            64, self.num_heads, self.ff_dim, dropout_rate=0.1, name=f"{prefix}_transformer"
+        )(x, deterministic=deterministic)
+        x = x[:, 0, :]
+        x = nn.LayerNorm(name=f"{prefix}_bn")(x)
+        return x
+
+    @nn.compact
+    def __call__(self, vitals: jnp.ndarray, labs: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        det = not train
+        v = self._branch(vitals, "vitals", det)
+        l = self._branch(labs, "labs", det)
+        x = jnp.concatenate([v, l], axis=1)  # (B, 128)
+        x = nn.gelu(nn.Dense(64, name="fc1")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=det)(x)
+        x = nn.gelu(nn.Dense(32, name="fc2")(x))
+        return nn.sigmoid(nn.Dense(1, name="output")(x))

@@ -1,0 +1,117 @@
+"""Shared building blocks for the model zoo.
+
+TPU notes: all sequence layouts are NLC (batch, length, channels) so convs
+and matmuls feed the MXU with the channel dim innermost; pooling windows are
+resolved statically at trace time (no dynamic shapes under jit).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def adaptive_avg_pool1d(x: jnp.ndarray, output_size: int) -> jnp.ndarray:
+    """PyTorch-style AdaptiveAvgPool1d over the length axis of (B, L, C).
+
+    Matches torch semantics: output bin i averages input[floor(i*L/out) :
+    ceil((i+1)*L/out)].  L is static under jit so the bins unroll at trace
+    time.  (Reference uses nn.AdaptiveAvgPool1d(4), src/Model.py:38,46.)
+    """
+    length = x.shape[1]
+    outs = []
+    for i in range(output_size):
+        start = (i * length) // output_size
+        end = -(-((i + 1) * length) // output_size)  # ceil div
+        outs.append(jnp.mean(x[:, start:end, :], axis=1))
+    return jnp.stack(outs, axis=1)  # (B, output_size, C)
+
+
+def adaptive_max_pool1d(x: jnp.ndarray, output_size: int) -> jnp.ndarray:
+    length = x.shape[1]
+    outs = []
+    for i in range(output_size):
+        start = (i * length) // output_size
+        end = -(-((i + 1) * length) // output_size)
+        outs.append(jnp.max(x[:, start:end, :], axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-add/post-norm residual attention block.
+
+    Mirrors the reference's TransformerBlock (src/Model.py:166-191):
+    x = LN(x + Drop(MHA(x))); x = LN(x + Drop(FFN(x))), FFN = Dense(ff_dim)
+    -> GELU -> Drop -> Dense(dim).
+    """
+
+    dim: int
+    num_heads: int
+    ff_dim: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.dim,
+            out_features=self.dim,
+            dropout_rate=self.dropout_rate,
+            deterministic=deterministic,
+            name="attention",
+        )(x, x)
+        x = nn.LayerNorm(name="attention_norm")(
+            x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(attn)
+        )
+        y = nn.Dense(self.ff_dim, name="ffn_dense1")(x)
+        y = nn.gelu(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        y = nn.Dense(self.dim, name="ffn_dense2")(y)
+        x = nn.LayerNorm(name="ffn_norm")(
+            x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        )
+        return x
+
+
+class TorchEncoderLayer(nn.Module):
+    """Post-norm Transformer encoder layer with ReLU FFN, matching
+    torch.nn.TransformerEncoderLayer defaults (used by the reference HAR
+    model, src/Model.py:441-442)."""
+
+    dim: int
+    num_heads: int
+    ff_dim: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.dim,
+            out_features=self.dim,
+            dropout_rate=self.dropout_rate,
+            deterministic=deterministic,
+            name="self_attn",
+        )(x, x)
+        x = nn.LayerNorm(name="norm1")(
+            x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(attn)
+        )
+        y = nn.Dense(self.ff_dim, name="linear1")(x)
+        y = nn.relu(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        y = nn.Dense(self.dim, name="linear2")(y)
+        x = nn.LayerNorm(name="norm2")(
+            x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        )
+        return x
+
+
+def sinusoidal_position_encoding(max_len: int, d_model: int) -> np.ndarray:
+    """Classic sin/cos table (reference: src/Model.py:420-433)."""
+    pe = np.zeros((max_len, d_model), dtype=np.float32)
+    pos = np.arange(max_len, dtype=np.float32)[:, None]
+    div = np.exp(np.arange(0, d_model, 2, dtype=np.float32) * (-np.log(10000.0) / d_model))
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe

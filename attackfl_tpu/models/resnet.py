@@ -1,0 +1,60 @@
+"""ResNet-18 for CIFAR-10 (BASELINE config 5).
+
+The reference has no CIFAR model — only CIFAR10 *evaluation* plumbing
+(src/Validation.py:38-44,69-90, expecting log-probability outputs for
+``F.nll_loss``).  This is a new Flax model: standard CIFAR-style ResNet-18
+(3x3 stem, no max-pool) with GroupNorm instead of BatchNorm — batch-stats
+aggregation is ill-defined under federated averaging, and GroupNorm is the
+standard substitution in FL (e.g. Hsieh et al., "The Non-IID Data Quagmire").
+Outputs log-softmax over 10 classes to satisfy the NLL-based validation
+contract.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from attackfl_tpu.registry import register_model
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=min(32, self.features), name="gn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=min(32, self.features), name="gn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False, name="proj")(x)
+            residual = nn.GroupNorm(num_groups=min(32, self.features), name="gn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+@register_model("ResNet18")
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
+    stage_features: tuple[int, ...] = (64, 128, 256, 512)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 4 and x.shape[1] == 3 and x.shape[-1] != 3:
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW (torch layout) -> NHWC
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, name="stem")(x)
+        x = nn.GroupNorm(num_groups=32, name="gn_stem")(x)
+        x = nn.relu(x)
+        for stage, (num_blocks, features) in enumerate(zip(self.stage_sizes, self.stage_features)):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResidualBlock(features, strides, name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, name="classifier")(x)
+        return nn.log_softmax(x, axis=-1)

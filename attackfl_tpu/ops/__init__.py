@@ -1,0 +1,1 @@
+from attackfl_tpu.ops import pytree  # noqa: F401

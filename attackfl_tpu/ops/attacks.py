@@ -1,0 +1,186 @@
+"""Model-poisoning attacks as pure JAX tensor programs.
+
+The reference implements five attacks as Python loops over state_dicts,
+executed inside malicious client processes on genuine models the server
+leaks to them (src/Utils.py:52-214, invoked from
+RpcClient.malicious_training, src/RpcClient.py:119-145).  Here each attack
+is a pure function of the *stacked* leaked genuine updates (leading axis =
+leaked models), with the γ binary searches expressed as
+``jax.lax.while_loop`` — fully jittable and vmap-able over many attackers.
+
+Semantics parity notes:
+* ``distance`` is the reference's ``compute_distance`` — a SUM of per-leaf
+  L2 norms, not a global norm (src/Utils.py:30-49).  Pass
+  ``matrix_spectral=True`` to reproduce torch's ord=2 spectral norm on 2-D
+  leaves (see ops/pytree._leaf_norm).
+* statistics use Bessel-corrected std (torch.std default, Utils.py:90).
+* the γ loop returns the candidate from the *final iteration* whether or
+  not it satisfied the constraint — exactly the reference's loop structure
+  (Utils.py:118-131,152-165,190-203).
+* the reference aliases genuine_models[0] and mutates it while searching
+  (Utils.py:121,154,192,209 — flagged in SURVEY.md §2 as a bug); we
+  evaluate candidates against the *unmodified* genuine set.  For Min-Sum
+  this means distances to all k models are counted rather than k-1.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from attackfl_tpu.ops import pytree as pt
+
+DEFAULT_RANDOM_SIGMA = 1e6  # Utils.py:52
+DEFAULT_LIE_Z = 0.74  # Utils.py:207, README.md:124
+DEFAULT_GAMMA = 50.0  # Utils.py:101,135,169
+DEFAULT_TAU = 1.0
+
+
+def random_attack(own_params: Any, rng: jax.Array, perturbation: float = DEFAULT_RANDOM_SIGMA) -> Any:
+    """Add N(0, perturbation²) noise to every parameter
+    (reference: create_random_base_model, Utils.py:52-57)."""
+    leaves, treedef = jax.tree.flatten(own_params)
+    keys = jax.random.split(rng, len(leaves))
+    noisy = [
+        leaf + jax.random.normal(k, leaf.shape, leaf.dtype) * perturbation
+        for leaf, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, noisy)
+
+
+def lie_attack(genuine_stacked: Any, z: float = DEFAULT_LIE_Z) -> Any:
+    """Little-Is-Enough: per-element mean + z·std over the leaked models
+    (reference: create_LIE_state_dict, Utils.py:207-214)."""
+    mean = pt.tree_mean(genuine_stacked)
+    std = pt.tree_std(genuine_stacked, ddof=1)
+    return jax.tree.map(lambda m, s: m + z * s, mean, std)
+
+
+def _gamma_search(
+    genuine_stacked: Any,
+    perturbation: Any,
+    max_distance: jnp.ndarray,
+    accepts,
+    gamma0: float,
+    tau: float,
+):
+    """Shared γ binary search (reference loop shape, Utils.py:115-131).
+
+    ``accepts(candidate) -> bool`` checks the constraint; the candidate is
+    ``mean - γ·perturbation``.  Returns the candidate of the last iteration.
+    """
+    mean = pt.tree_mean(genuine_stacked)
+
+    def candidate_for(gamma):
+        return jax.tree.map(lambda m, p: m - gamma * p, mean, perturbation)
+
+    def cond(carry):
+        gamma, gamma_succ, step, last_tried = carry
+        return jnp.abs(gamma_succ - gamma) > tau
+
+    def body(carry):
+        gamma, gamma_succ, step, _ = carry
+        ok = accepts(candidate_for(gamma), max_distance)
+        new_succ = jnp.where(ok, gamma, gamma_succ)
+        new_gamma = jnp.where(ok, gamma + step / 2.0, gamma - step / 2.0)
+        return (new_gamma, new_succ, step / 2.0, gamma)
+
+    init = (jnp.asarray(gamma0), jnp.asarray(0.0), jnp.asarray(gamma0), jnp.asarray(gamma0))
+    _, _, _, last_tried = jax.lax.while_loop(cond, body, init)
+    return candidate_for(last_tried)
+
+
+def min_max_attack(
+    genuine_stacked: Any,
+    gamma0: float = DEFAULT_GAMMA,
+    tau: float = DEFAULT_TAU,
+    matrix_spectral: bool = False,
+) -> Any:
+    """Min-Max (Shejwalkar & Houmansadr 2021): candidate = mean − γ·std with
+    the largest γ keeping max distance-to-any-genuine below the max pairwise
+    genuine distance (reference: create_min_max_model, Utils.py:135-166)."""
+    std = pt.tree_std(genuine_stacked, ddof=1)
+    pair = pt.pairwise_ref_distance(genuine_stacked, matrix_spectral)
+    max_distance = jnp.max(pair)
+
+    def accepts(candidate, max_d):
+        d = pt.distance_to_each(candidate, genuine_stacked, matrix_spectral)
+        return jnp.max(d) < max_d
+
+    return _gamma_search(genuine_stacked, std, max_distance, accepts, gamma0, tau)
+
+
+def min_sum_attack(
+    genuine_stacked: Any,
+    gamma0: float = DEFAULT_GAMMA,
+    tau: float = DEFAULT_TAU,
+    matrix_spectral: bool = False,
+) -> Any:
+    """Min-Sum: constraint on the SUM of squared distances vs the max
+    per-genuine-model sum (reference: create_min_sum_model,
+    Utils.py:169-204)."""
+    std = pt.tree_std(genuine_stacked, ddof=1)
+    pair = pt.pairwise_ref_distance(genuine_stacked, matrix_spectral)
+    # per-model sum over squared distances to the others (diag is 0)
+    sums = jnp.sum(jnp.square(pair), axis=1)
+    max_distance = jnp.max(sums)
+
+    def accepts(candidate, max_d):
+        d = pt.distance_to_each(candidate, genuine_stacked, matrix_spectral)
+        return jnp.sum(jnp.square(d)) < max_d
+
+    return _gamma_search(genuine_stacked, std, max_distance, accepts, gamma0, tau)
+
+
+def opt_fang_attack(
+    genuine_stacked: Any,
+    gamma0: float = DEFAULT_GAMMA,
+    tau: float = DEFAULT_TAU,
+    matrix_spectral: bool = False,
+) -> Any:
+    """Opt-Fang (Fang et al. 2020 optimized variant): perturbation direction
+    is sign(mean) under the Min-Max acceptance rule
+    (reference: create_opt_fang_model, Utils.py:101-132)."""
+    mean = pt.tree_mean(genuine_stacked)
+    sign = jax.tree.map(jnp.sign, mean)
+    pair = pt.pairwise_ref_distance(genuine_stacked, matrix_spectral)
+    max_distance = jnp.max(pair)
+
+    def accepts(candidate, max_d):
+        d = pt.distance_to_each(candidate, genuine_stacked, matrix_spectral)
+        return jnp.max(d) < max_d
+
+    return _gamma_search(genuine_stacked, sign, max_distance, accepts, gamma0, tau)
+
+
+def apply_attack(
+    mode: str,
+    own_params: Any,
+    genuine_stacked: Any,
+    rng: jax.Array,
+    args: tuple[float, ...] = (),
+    matrix_spectral: bool = False,
+) -> Any:
+    """Dispatch by attack-mode string (reference: RpcClient.py:119-145).
+
+    γ-search attacks degrade to the attacker's own params when fewer than
+    two genuine models were leaked (Utils.py:102,136,170); the round engine
+    enforces that with a static leak count.
+    """
+    num_leaked = jax.tree.leaves(genuine_stacked)[0].shape[0] if genuine_stacked is not None else 0
+    if mode == "Random":
+        sigma = args[0] if args else DEFAULT_RANDOM_SIGMA
+        return random_attack(own_params, rng, sigma)
+    if mode == "LIE":
+        z = args[0] if args else DEFAULT_LIE_Z
+        return lie_attack(genuine_stacked, z)
+    if mode in ("Min-Max", "Min-Sum", "Opt-Fang"):
+        if num_leaked <= 1:
+            return own_params
+        gamma0 = args[0] if len(args) > 0 else DEFAULT_GAMMA
+        tau = args[1] if len(args) > 1 else DEFAULT_TAU
+        fn = {"Min-Max": min_max_attack, "Min-Sum": min_sum_attack, "Opt-Fang": opt_fang_attack}[mode]
+        return fn(genuine_stacked, gamma0, tau, matrix_spectral)
+    raise ValueError(f"Attack client not contain '{mode}' algorithm.")

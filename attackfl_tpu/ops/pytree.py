@@ -1,0 +1,233 @@
+"""Pytree utilities: the framework's equivalent of the reference's
+state_dict arithmetic (reference: src/Utils.py:30-49,218-226,250-255,360-361).
+
+Client model parameters are JAX pytrees; N clients are the *leading axis* of
+every leaf ("stacked" trees).  All aggregation/attack math reduces along
+that axis, which under pjit sharding compiles to ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# stacking
+# ---------------------------------------------------------------------------
+
+def tree_stack(trees: list[Pytree]) -> Pytree:
+    """Stack a list of identical-structure trees along a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_unstack(tree: Pytree) -> list[Pytree]:
+    """Inverse of :func:`tree_stack`."""
+    leaves, treedef = jax.tree.flatten(tree)
+    n = leaves[0].shape[0]
+    return [jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves]) for i in range(n)]
+
+
+def tree_take(tree: Pytree, idx) -> Pytree:
+    """Index / gather along the leading (client) axis of a stacked tree."""
+    return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), tree)
+
+
+def tree_select(mask, tree_a: Pytree, tree_b: Pytree) -> Pytree:
+    """Per-client select: ``mask[i] ? tree_a[i] : tree_b[i]``.
+
+    ``mask`` has shape (N,) and broadcasts against each leaf's leading axis.
+    """
+
+    def sel(a, b):
+        m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+
+    return jax.tree.map(sel, tree_a, tree_b)
+
+
+def tree_broadcast(tree: Pytree, n: int) -> Pytree:
+    """Replicate a single tree across a new leading client axis of size n."""
+    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
+
+
+# ---------------------------------------------------------------------------
+# flattening
+# ---------------------------------------------------------------------------
+
+def tree_ravel(tree: Pytree) -> jnp.ndarray:
+    """Concatenate all leaves into one flat vector.
+
+    Equivalent of the reference's ``state_dict_to_vector`` /
+    ``flatten_state_dict`` / ``get_weight_vector`` trio
+    (src/Utils.py:225-226,250-255,360-361).  Leaf order is jax.tree order
+    (stable for a fixed structure).
+    """
+    leaves = jax.tree.leaves(tree)
+    return jnp.concatenate([jnp.ravel(x) for x in leaves]) if leaves else jnp.zeros((0,))
+
+
+def tree_ravel_stacked(stacked: Pytree) -> jnp.ndarray:
+    """Flatten a stacked tree to a (N, P) matrix, one row per client."""
+    leaves = jax.tree.leaves(stacked)
+    n = leaves[0].shape[0]
+    return jnp.concatenate([x.reshape(n, -1) for x in leaves], axis=1)
+
+
+def tree_unravel_like(flat: jnp.ndarray, template: Pytree) -> Pytree:
+    """Reshape a flat (P,) vector back into the structure of ``template``."""
+    leaves, treedef = jax.tree.flatten(template)
+    out, off = [], 0
+    for leaf in leaves:
+        size = leaf.size
+        out.append(flat[off : off + size].reshape(leaf.shape).astype(leaf.dtype))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def tree_size(tree: Pytree) -> int:
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+# ---------------------------------------------------------------------------
+# norms & distances
+# ---------------------------------------------------------------------------
+
+def tree_l2_norm(tree: Pytree) -> jnp.ndarray:
+    """Global L2 norm over the concatenation of all leaves.
+
+    Matches the reference's FLTrust norm ``sqrt(sum ||p||^2)``
+    (server.py:714,724).
+    """
+    sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(tree))
+    return jnp.sqrt(sq)
+
+
+def tree_cosine(a: Pytree, b: Pytree, eps: float = 1e-12) -> jnp.ndarray:
+    """Cosine similarity of two trees as flat vectors
+    (reference: src/Utils.py:218-222, server.py:682-693)."""
+    dot = sum(jnp.vdot(x, y) for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+    return dot / (tree_l2_norm(a) * tree_l2_norm(b) + eps)
+
+
+def _leaf_norm(diff: jnp.ndarray, matrix_spectral: bool) -> jnp.ndarray:
+    """Per-leaf norm used by :func:`ref_distance`.
+
+    The reference computes ``torch.linalg.norm(diff, ord=2)`` per tensor
+    (src/Utils.py:47) — for 1-D tensors that is the vector L2 norm, but for
+    2-D tensors torch gives the *spectral* norm (largest singular value).
+    ``matrix_spectral=True`` reproduces that behavior exactly; the default
+    False uses the Frobenius norm on every leaf, which is the textbook
+    Min-Max/Min-Sum distance and is well-defined for >2-D leaves (where the
+    reference would raise).
+    """
+    if matrix_spectral and diff.ndim == 2:
+        return jnp.linalg.norm(diff, ord=2)
+    return jnp.sqrt(jnp.sum(jnp.square(diff)))
+
+
+def ref_distance(a: Pytree, b: Pytree, matrix_spectral: bool = False) -> jnp.ndarray:
+    """Sum over leaves of the per-leaf norm of (a - b).
+
+    This is the reference's ``compute_distance`` (src/Utils.py:30-49):
+    NOT a global L2 norm but a sum of per-tensor norms.  All γ-search
+    attacks and their acceptance thresholds use this metric.
+    """
+    total = jnp.asarray(0.0)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        total = total + _leaf_norm(x - y, matrix_spectral)
+    return total
+
+
+def pairwise_ref_distance(stacked: Pytree, matrix_spectral: bool = False) -> jnp.ndarray:
+    """(N, N) matrix of :func:`ref_distance` between all stacked rows.
+
+    The default Frobenius path uses the Gram identity
+    ``||xi−xj||² = ||xi||² + ||xj||² − 2⟨xi,xj⟩`` per leaf, avoiding the
+    (N, N, leaf) broadcast tensor (which would OOM for big models under a
+    vmap over attackers); only the opt-in spectral path materializes diffs.
+    """
+    leaves = jax.tree.leaves(stacked)
+    n = leaves[0].shape[0]
+    total = jnp.zeros((n, n))
+    for x in leaves:
+        if matrix_spectral and x.ndim - 1 == 2:
+            diff = x[:, None] - x[None, :]  # (N, N, r, c)
+            norms = jnp.linalg.norm(diff, ord=2, axis=(-2, -1))
+        else:
+            flat = x.reshape(n, -1)
+            sq_norms = jnp.sum(jnp.square(flat), axis=1)
+            gram = flat @ flat.T
+            sq = sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
+            norms = jnp.sqrt(jnp.maximum(sq, 0.0))
+        total = total + norms
+    return total
+
+
+def distance_to_each(candidate: Pytree, stacked: Pytree, matrix_spectral: bool = False) -> jnp.ndarray:
+    """(N,) vector of ref_distance(candidate, stacked[i])."""
+    leaves_c = jax.tree.leaves(candidate)
+    leaves_s = jax.tree.leaves(stacked)
+    n = leaves_s[0].shape[0]
+    total = jnp.zeros((n,))
+    for c, s in zip(leaves_c, leaves_s):
+        diff = s - c[None]
+        if matrix_spectral and c.ndim == 2:
+            norms = jnp.linalg.norm(diff, ord=2, axis=(-2, -1))
+        else:
+            norms = jnp.sqrt(jnp.sum(jnp.square(diff.reshape(n, -1)), axis=-1))
+        total = total + norms
+    return total
+
+
+# ---------------------------------------------------------------------------
+# statistics along the client axis
+# ---------------------------------------------------------------------------
+
+def tree_mean(stacked: Pytree, axis: int = 0) -> Pytree:
+    return jax.tree.map(lambda x: jnp.mean(x, axis=axis), stacked)
+
+
+def tree_std(stacked: Pytree, axis: int = 0, ddof: int = 1) -> Pytree:
+    """Per-element std along the client axis.
+
+    ``ddof=1`` (Bessel-corrected) matches ``torch.std``'s default used by
+    the reference's LIE/Min-Max/Min-Sum statistics (src/Utils.py:90).
+    When the axis has a single element the sample std is undefined
+    (torch returns NaN); we return zeros so a 1-model leak degrades to the
+    mean rather than poisoning the run with NaNs.
+    """
+
+    def _std(x):
+        n = x.shape[axis]
+        if n <= ddof:
+            return jnp.zeros(x.shape[:axis] + x.shape[axis + 1 :], x.dtype)
+        return jnp.std(x, axis=axis, ddof=ddof)
+
+    return jax.tree.map(_std, stacked)
+
+
+def tree_weighted_mean(stacked: Pytree, weights: jnp.ndarray) -> Pytree:
+    """Weighted mean along the client axis; weights (N,) are normalized
+    by their sum (size-weighted FedAvg, reference: server.py:766-772)."""
+    w = weights / jnp.sum(weights)
+
+    def wmean(x):
+        wb = w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return jnp.sum(x * wb, axis=0)
+
+    return jax.tree.map(wmean, stacked)
+
+
+def tree_map_with_path_names(fn: Callable[[str, jnp.ndarray], jnp.ndarray], tree: Pytree) -> Pytree:
+    """Map with a dotted path name per leaf (registry-style names)."""
+
+    def _fn(path, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        return fn(name, leaf)
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)

@@ -1,0 +1,6 @@
+from attackfl_tpu.parallel.mesh import (  # noqa: F401
+    make_client_mesh,
+    client_sharding,
+    shard_stacked,
+    replicate,
+)

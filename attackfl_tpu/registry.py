@@ -1,0 +1,36 @@
+"""Name-keyed model registry.
+
+The reference resolves the config's ``model:`` string by reflection on its
+model module — ``getattr(src.Model, model_name)`` at server.py:139-142,
+src/RpcClient.py:74-77 and src/Validation.py:25-28 — making class names part
+of the public API.  This registry preserves that contract (same names:
+``CNNModel``, ``RNNModel``, ``TransformerModel``, ``TransformerClassifier``)
+with an explicit table instead of reflection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+MODEL_REGISTRY: dict[str, Callable] = {}
+
+
+def register_model(name: str) -> Callable:
+    def deco(cls):
+        MODEL_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    """Instantiate a registered model by name (the reference's
+    ``getattr(src.Model, name)()`` call)."""
+    # Import for side-effect registration on first use.
+    import attackfl_tpu.models  # noqa: F401
+
+    if name not in MODEL_REGISTRY:
+        raise ValueError(
+            f"Model name '{name}' is not valid. Registered: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name](**kwargs)

@@ -1,0 +1,385 @@
+"""The Simulator: in-process federation engine.
+
+This replaces the reference's entire server/client process topology
+(server.py Server class + N client.py processes + RabbitMQ): registration,
+broadcast, the UPDATE barrier (server.py:271-272), aggregation dispatch
+(server.py:286-494), genuine-model leaking (server.py:596-616), validation
+gating, checkpointing and the round-retry loop (server.py:539-567) — all
+driven from one Python loop around jitted round programs.
+
+Round/retry semantics parity: a failed round (client NaN or failed
+validation) is retried without decrementing the remaining-round counter
+(server.py:546-563); the attack clock advances per *broadcast*, matching
+the client-side ``training_round`` counter (RpcClient.py:72).  Unlike the
+reference (which retries forever), retries are capped.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.data.partition import dirichlet_label_partition
+from attackfl_tpu.data.synthetic import get_dataset
+from attackfl_tpu.eval.validation import Validation
+from attackfl_tpu.models.hyper import make_hypernetwork
+from attackfl_tpu.ops import defenses
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.parallel.mesh import make_client_mesh, make_constrain
+from attackfl_tpu.registry import get_model
+from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
+from attackfl_tpu.training.round import build_aggregator, build_attack_groups, build_round_step
+from attackfl_tpu.utils import checkpoint as ckpt
+from attackfl_tpu.utils.logging import Logger, print_with_color
+
+MAX_ROUND_RETRIES = 20
+
+
+def sample_inputs(data_name: str):
+    """Minimal input structure for model.init per dataset."""
+    if data_name == "ICU":
+        return (jnp.zeros((1, 7)), jnp.zeros((1, 16)))
+    if data_name == "HAR":
+        return (jnp.zeros((1, 561)),)
+    if data_name == "CIFAR10":
+        return (jnp.zeros((1, 32, 32, 3)),)
+    raise ValueError(f"Data name '{data_name}' is not valid.")
+
+
+class Simulator:
+    """End-to-end federated simulation for one Config."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        train_data: dict[str, np.ndarray] | None = None,
+        test_data: dict[str, np.ndarray] | None = None,
+        logger: Logger | None = None,
+        use_mesh: bool = False,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        self.logger = logger or Logger(f"{cfg.log_path}/app.log")
+        self.model = get_model(cfg.model)
+
+        train_np = train_data if train_data is not None else get_dataset(
+            cfg.data_name, "train", cfg.train_size, cfg.random_seed
+        )
+        test_np = test_data if test_data is not None else get_dataset(
+            cfg.data_name, "test", cfg.test_size, cfg.random_seed
+        )
+        self.train_data = {k: jnp.asarray(v) for k, v in train_np.items()}
+        self.test_np = test_np
+
+        self.attack_groups, self.genuine_idx = build_attack_groups(cfg)
+        self.attacker_mask = np.zeros(cfg.total_clients, dtype=bool)
+        for grp in self.attack_groups:
+            self.attacker_mask[list(grp.indices)] = True
+
+        self.client_pools = None
+        if cfg.partition == "dirichlet":
+            pools = dirichlet_label_partition(
+                np.asarray(train_np["label"]), cfg.total_clients,
+                cfg.dirichlet_alpha, seed=cfg.random_seed,
+            )
+            self.client_pools = jnp.asarray(pools)
+
+        # ---- mesh / sharding -------------------------------------------
+        self.mesh = mesh
+        if use_mesh and mesh is None:
+            self.mesh = make_client_mesh(cfg.mesh.num_devices, cfg.mesh.axis_name)
+        if self.mesh is not None and cfg.total_clients % self.mesh.size != 0:
+            print_with_color(
+                f"[mesh] {cfg.total_clients} clients not divisible by "
+                f"{self.mesh.size} devices; running replicated.", "yellow")
+            self.mesh = None
+        constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
+
+        # ---- validation -------------------------------------------------
+        self.validation = None
+        if cfg.validation:
+            self.validation = Validation(self.model, cfg.data_name, test_np, self.logger)
+
+        # ---- mode-specific programs ------------------------------------
+        self.is_hyper = cfg.mode == "hyper"
+        if self.is_hyper:
+            init_rng = jax.random.PRNGKey(cfg.random_seed)
+            template = self.model.init(init_rng, *sample_inputs(cfg.data_name))["params"]
+            self.target_template = template
+            self.hnet, self.hnet_apply = make_hypernetwork(
+                template, cfg.total_clients, embedding_dim=8, hidden_dim=100,
+                spec_norm=False, n_hidden=2,
+            )
+            round_step, generate_all = build_hyper_round(
+                self.model, cfg, self.train_data, self.attack_groups,
+                self.genuine_idx, self.hnet_apply, self.client_pools, constrain,
+            )
+            self.round_step = jax.jit(round_step)
+            self.generate_all = jax.jit(generate_all)
+            hyper_update, self.hyper_tx = build_hyper_update(
+                cfg, self.hnet_apply, cfg.total_clients
+            )
+            self.hyper_update = jax.jit(hyper_update)
+            self.detector = None
+            if cfg.hyper_detection.enable:
+                hd = cfg.hyper_detection
+                self.detector = defenses.HyperDetector(
+                    cfg.total_clients, hd.cosine_search, hd.n_components,
+                    hd.eps, hd.min_samples, hd.start_round,
+                    save_path=f"{cfg.log_path}/all_embeddings.npy",
+                )
+        else:
+            round_step = build_round_step(
+                self.model, cfg, self.train_data, self.attack_groups,
+                self.genuine_idx, self.client_pools, constrain,
+            )
+            self.round_step = jax.jit(round_step)
+            self.aggregate = jax.jit(build_aggregator(self.model, cfg, test_np))
+
+        self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+
+    def init_state(self, seed: int | None = None) -> dict[str, Any]:
+        """Fresh simulation state (the reference's fresh-init path,
+        server.py:160-162)."""
+        seed = self.cfg.random_seed if seed is None else seed
+        rng = jax.random.PRNGKey(seed)
+        k_model, k_state = jax.random.split(rng)
+        num_genuine = len(self.genuine_idx)
+
+        if self.is_hyper:
+            hnet_params = self.hnet.init(k_model, jnp.asarray(0))["params"]
+            opt_state = make_hyper_optimizer(self.cfg).init(hnet_params)
+            template = self.target_template
+            prev_genuine = pt.tree_broadcast(
+                jax.tree.map(jnp.zeros_like, template), num_genuine
+            )
+            state = {
+                "hnet_params": hnet_params,
+                "hyper_opt_state": opt_state,
+                "prev_genuine": prev_genuine,
+                "have_genuine": np.asarray(False),
+                "active_mask": np.ones(self.cfg.total_clients, np.float32),
+                "rng": k_state,
+                "completed_rounds": np.asarray(0),
+                "broadcasts": np.asarray(0),
+            }
+        else:
+            params = self.model.init(k_model, *sample_inputs(self.cfg.data_name))["params"]
+            prev_genuine = pt.tree_broadcast(
+                jax.tree.map(jnp.zeros_like, params), num_genuine
+            )
+            state = {
+                "global_params": params,
+                "prev_genuine": prev_genuine,
+                "have_genuine": np.asarray(False),
+                "rng": k_state,
+                "completed_rounds": np.asarray(0),
+                "broadcasts": np.asarray(0),
+            }
+        return state
+
+    def load_or_init_state(self) -> dict[str, Any]:
+        """Resume from checkpoint when configured
+        (reference: server.py:144-163,578-586)."""
+        state = self.init_state()
+        if self.cfg.load_parameters:
+            path = ckpt.checkpoint_path(self.cfg)
+            try:
+                state = ckpt.load_state(path, state)
+                print_with_color(f"Load state from checkpoint: {path}", "yellow")
+            except FileNotFoundError:
+                pass
+        return state
+
+    # ------------------------------------------------------------------
+    # one round
+    # ------------------------------------------------------------------
+
+    def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
+        """Execute one broadcast->train->attack->aggregate->validate round.
+
+        Returns (new_state, metrics).  On failure (``metrics["ok"]`` False)
+        the returned state keeps the previous global/hyper params but
+        advances the rng, broadcast clock and genuine-leak cache — matching
+        the reference's retry path (server.py:546-567).
+        """
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        rng, k_round, k_agg = jax.random.split(state["rng"], 3)
+        broadcast_number = int(state["broadcasts"]) + 1
+        metrics: dict[str, Any] = {"round": int(state["completed_rounds"]) + 1,
+                                   "broadcast": broadcast_number}
+
+        if self.is_hyper:
+            new_state, metrics = self._run_hyper_round(
+                state, rng, k_round, broadcast_number, metrics
+            )
+        else:
+            new_state, metrics = self._run_plain_round(
+                state, rng, k_round, k_agg, broadcast_number, metrics
+            )
+        metrics["seconds"] = time.perf_counter() - t0
+        return new_state, metrics
+
+    def _run_plain_round(self, state, rng, k_round, k_agg, broadcast_number, metrics):
+        cfg = self.cfg
+        stacked, sizes, new_genuine, ok, loss = self.round_step(
+            state["global_params"], state["prev_genuine"],
+            jnp.asarray(bool(state["have_genuine"])), k_round,
+            jnp.asarray(broadcast_number),
+        )
+        ok = bool(ok)
+        metrics["train_loss"] = float(loss)
+
+        weights_mask = jnp.ones((cfg.total_clients,), jnp.float32)
+        if ok and cfg.mode == "gmm":
+            flat = np.asarray(self._ravel_stacked(stacked))
+            keep = defenses.gmm_filter(flat, self.attacker_mask, seed=cfg.random_seed)
+            metrics["gmm_kept"] = int(keep.sum())
+            if not keep.any():
+                ok = False  # round fails when no client survives (server.py:369-372)
+            weights_mask = jnp.asarray(keep, jnp.float32)
+        elif ok and cfg.mode == "fltracer":
+            flat = np.asarray(self._ravel_stacked(stacked))
+            anomalies = defenses.fltracer_anomalies(flat)
+            metrics["fltracer_anomalies"] = anomalies.tolist()
+            mask = np.ones(cfg.total_clients, np.float32)
+            mask[anomalies] = 0.0
+            if not mask.any():
+                ok = False
+            weights_mask = jnp.asarray(mask)
+
+        new_global = state["global_params"]
+        if ok:
+            new_global = self.aggregate(
+                state["global_params"], stacked, sizes, weights_mask, k_agg
+            )
+            if self.validation is not None:
+                val_ok, val_metrics = self.validation.test(new_global)
+                metrics.update(val_metrics)
+                ok = ok and val_ok
+
+        metrics["ok"] = ok
+        new_state = dict(state)
+        new_state["rng"] = rng
+        new_state["broadcasts"] = np.asarray(broadcast_number)
+        new_state["prev_genuine"] = new_genuine
+        new_state["have_genuine"] = np.asarray(True)
+        if ok:
+            new_state["global_params"] = new_global
+            new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
+        return new_state, metrics
+
+    def _run_hyper_round(self, state, rng, k_round, broadcast_number, metrics):
+        cfg = self.cfg
+        active_mask = jnp.asarray(state["active_mask"])
+        stacked, sizes, new_genuine, ok, loss = self.round_step(
+            state["hnet_params"], state["prev_genuine"],
+            jnp.asarray(bool(state["have_genuine"])), active_mask, k_round,
+            jnp.asarray(broadcast_number),
+        )
+        ok = bool(ok)
+        metrics["train_loss"] = float(loss)
+
+        # snapshot for detection rollback (reference: server.py:296-298)
+        prev_hnet = state["hnet_params"] if self.detector is not None else None
+        prev_opt = state["hyper_opt_state"] if self.detector is not None else None
+
+        hnet_params, opt_state = state["hnet_params"], state["hyper_opt_state"]
+        new_active = np.asarray(state["active_mask"]).copy()
+        if ok:
+            hnet_params, opt_state = self.hyper_update(
+                hnet_params, opt_state, stacked, active_mask
+            )
+
+            gen_params = None
+            if self.detector is not None:
+                gen_params, embeddings = self.generate_all(hnet_params)
+                selected = [int(i) for i in np.flatnonzero(new_active > 0)]
+                emb_np = np.asarray(embeddings)[selected]
+                removals = self.detector.observe(broadcast_number, selected, emb_np)
+                if removals:
+                    print_with_color(f"Removing anomalies {removals}, rolling back", "yellow")
+                    metrics["removed_clients"] = removals
+                    for cid in removals:
+                        new_active[cid] = 0.0
+                    hnet_params, opt_state = prev_hnet, prev_opt
+                    gen_params = None  # rollback invalidates the generation
+
+            if self.validation is not None:
+                if gen_params is None:
+                    gen_params, _ = self.generate_all(hnet_params)
+                active_ids = jnp.asarray(np.flatnonzero(new_active > 0))
+                val_ok, val_metrics = self.validation.test_hyper(
+                    pt.tree_take(gen_params, active_ids)
+                )
+                metrics.update(val_metrics)
+                ok = ok and val_ok
+
+        metrics["ok"] = ok
+        new_state = dict(state)
+        new_state["rng"] = rng
+        new_state["broadcasts"] = np.asarray(broadcast_number)
+        new_state["prev_genuine"] = new_genuine
+        new_state["have_genuine"] = np.asarray(True)
+        new_state["active_mask"] = new_active
+        if ok:
+            new_state["hnet_params"] = hnet_params
+            new_state["hyper_opt_state"] = opt_state
+            new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
+        return new_state, metrics
+
+    # ------------------------------------------------------------------
+    # full run
+    # ------------------------------------------------------------------
+
+    def run(
+        self,
+        num_rounds: int | None = None,
+        state: dict[str, Any] | None = None,
+        save_checkpoints: bool = True,
+        verbose: bool = True,
+    ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+        """Run until ``num_rounds`` rounds complete (reference main loop,
+        server.py:559-567)."""
+        cfg = self.cfg
+        num_rounds = num_rounds if num_rounds is not None else cfg.num_round
+        state = state if state is not None else self.load_or_init_state()
+        history: list[dict[str, Any]] = []
+        retries = 0
+        self.logger.log_info("### Application start ###")
+
+        while int(state["completed_rounds"]) < num_rounds:
+            round_no = int(state["completed_rounds"]) + 1
+            if verbose:
+                print_with_color(f"Start training round {round_no}", "yellow")
+            state, metrics = self.run_round(state)
+            history.append(metrics)
+            if metrics["ok"]:
+                retries = 0
+                if save_checkpoints:
+                    ckpt.save_state(ckpt.checkpoint_path(cfg), state)
+                if verbose:
+                    keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in metrics]
+                    msg = " ".join(f"{k}={metrics[k]:.4f}" for k in keys)
+                    print_with_color(
+                        f"Round {round_no} done in {metrics['seconds']:.2f}s {msg}", "green")
+            else:
+                retries += 1
+                print_with_color("Training failed!", "yellow")
+                self.logger.log_warning(f"Round {round_no} failed (retry {retries})")
+                if retries > MAX_ROUND_RETRIES:
+                    raise RuntimeError(
+                        f"Round {round_no} failed {retries} times; aborting "
+                        "(the reference would retry forever, server.py:546-556)"
+                    )
+        return state, history

@@ -1,0 +1,177 @@
+"""Hypernetwork ("hyper") server mode: pFedHN-style personalized FL.
+
+The server owns a hypernetwork mapping client index -> full target-model
+parameters.  Broadcast is generation (``hnet(i)``), aggregation is
+hypernetwork training: for each client,
+``delta_theta = hnet(i) − client_params`` and the hnet gradient is the VJP
+of the generator applied to that cotangent — the reference computes exactly
+this with ``torch.autograd.grad(outputs=weights, inputs=hnet.params,
+grad_outputs=delta_theta)`` (server.py:654-659); in JAX it is literally
+``jax.vjp``.  The per-client updates are sequential through one shared
+Adam state (server.py:165,644-670) and are replicated here as a
+``lax.scan`` carrying (hnet_params, opt_state) — order-faithful.
+
+Client removal (hyper-detection) is handled with an ``active_mask`` so
+shapes stay static: inactive clients still flow through the vmapped
+trainer but their hnet contribution, genuine-leak eligibility and
+validation rows are masked out.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.data.partition import sample_round_indices
+from attackfl_tpu.ops import attacks
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.training.local import build_local_update
+from attackfl_tpu.training.round import AttackGroup
+
+Batch = dict[str, jnp.ndarray]
+
+
+def make_hyper_optimizer(cfg: Config) -> optax.GradientTransformation:
+    """Adam(hyper_lr) behind the configured grad clip
+    (server.py:165,667-668)."""
+    tx = []
+    if cfg.clip_grad_norm and cfg.clip_grad_norm > 0:
+        tx.append(optax.clip_by_global_norm(cfg.clip_grad_norm))
+    tx.append(optax.adam(cfg.hyper_lr, b1=0.9, b2=0.999, eps=1e-8))
+    return optax.chain(*tx)
+
+
+def build_hyper_round(
+    model,
+    cfg: Config,
+    train_data: Batch,
+    attack_groups: Sequence[AttackGroup],
+    genuine_idx: Sequence[int],
+    hnet_apply: Callable,
+    client_pools: jnp.ndarray | None = None,
+    constrain: Callable | None = None,
+) -> Callable:
+    """Build the client-side phase of a hyper round:
+
+    ``round_step(hnet_params, prev_genuine, have_genuine, active_mask, rng,
+    broadcast_number) -> (stacked_params, sizes, new_genuine, ok, loss)``
+
+    Personalized params are generated per client, locally trained under
+    vmap, and attacker rows are replaced by attacks computed from their own
+    broadcast weights + the previous round's leaked genuine updates —
+    mirroring that hyper-mode clients attack from hnet-generated weights
+    (RpcClient.py:80-104).
+    """
+    num_clients = cfg.total_clients
+    lo, hi = cfg.num_data_range
+    pool = next(iter(train_data.values())).shape[0]
+    num_genuine = len(genuine_idx)
+    leak_k = max(int(cfg.genuine_rate * num_genuine), 1)
+    genuine_arr = jnp.asarray(genuine_idx, dtype=jnp.int32)
+
+    local_update = build_local_update(
+        model, cfg.data_name, train_data,
+        epochs=cfg.epochs, batch_size=cfg.batch_size,
+        lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
+    )
+
+    constrain = constrain or (lambda tree: tree)
+
+    def generate_all(hnet_params):
+        """hnet(i) for every client: stacked personalized params +
+        embeddings (broadcast phase, server.py:588-590)."""
+        return jax.vmap(lambda i: hnet_apply(hnet_params, i))(
+            jnp.arange(num_clients)
+        )
+
+    def round_step(hnet_params, prev_genuine, have_genuine, active_mask, rng, broadcast_number):
+        broadcast_params, _emb = generate_all(hnet_params)
+        broadcast_params = constrain(broadcast_params)
+        k_data, k_train, k_attack = jax.random.split(rng, 3)
+        idx, mask, sizes = sample_round_indices(
+            k_data, num_clients, pool, lo, hi, client_pools
+        )
+        idx, mask = constrain(idx), constrain(mask)
+        train_keys = constrain(jax.random.split(k_train, num_clients))
+        stacked, ok, losses = jax.vmap(local_update, in_axes=(0, 0, 0, 0))(
+            broadcast_params, train_keys, idx, mask
+        )
+        stacked = constrain(stacked)
+
+        # genuine-leak eligibility: only active genuine clients can be leaked
+        active_genuine = active_mask[genuine_arr].astype(jnp.float32)
+        leak_p = active_genuine / jnp.maximum(jnp.sum(active_genuine), 1.0)
+
+        for gi, grp in enumerate(attack_groups):
+            n_attackers = len(grp.indices)
+            keys = jax.random.split(jax.random.fold_in(k_attack, gi), n_attackers)
+            active = (broadcast_number >= grp.attack_round) & have_genuine
+            grp_arr = jnp.asarray(grp.indices)
+            own_params = pt.tree_take(broadcast_params, grp_arr)
+
+            def attack_one(key, own):
+                k_leak, k_noise = jax.random.split(key)
+                leak = jax.random.choice(
+                    k_leak, num_genuine, (min(leak_k, num_genuine),),
+                    replace=False, p=leak_p,
+                )
+                leaked = pt.tree_take(prev_genuine, leak)
+                return attacks.apply_attack(grp.mode, own, leaked, k_noise, grp.args)
+
+            attacked = jax.vmap(attack_one)(keys, own_params)
+
+            def scatter(s, a):
+                new_rows = jnp.where(active, a, s[grp_arr])
+                return s.at[grp_arr].set(new_rows)
+
+            stacked = jax.tree.map(scatter, stacked, attacked)
+            ok = ok.at[grp_arr].set(jnp.where(active, True, ok[grp_arr]))
+
+        new_genuine = pt.tree_take(stacked, genuine_arr)
+        ok = jnp.all(ok | ~active_mask.astype(bool))
+        loss = jnp.sum(losses * active_mask) / jnp.maximum(jnp.sum(active_mask), 1.0)
+        return stacked, sizes, new_genuine, ok, loss
+
+    return round_step, generate_all
+
+
+def build_hyper_update(
+    cfg: Config,
+    hnet_apply: Callable,
+    num_clients: int,
+) -> tuple[Callable, optax.GradientTransformation]:
+    """Build the server-side hypernetwork training step:
+
+    ``hyper_update(hnet_params, opt_state, stacked_client_params,
+    active_mask) -> (hnet_params, opt_state)``
+
+    Sequential scan over clients through the shared Adam state — the
+    faithful re-expression of the reference's per-client loop
+    (server.py:644-670).  Inactive clients are skipped by keeping the carry
+    unchanged (masked select).
+    """
+    tx = make_hyper_optimizer(cfg)
+
+    def hyper_update(hnet_params, opt_state, stacked_client_params, active_mask):
+        def body(carry, xs):
+            hp, opt_s = carry
+            i, active = xs
+            client_params = pt.tree_take(stacked_client_params, i)
+            weights, vjp_fn = jax.vjp(lambda p: hnet_apply(p, i)[0], hp)
+            delta_theta = jax.tree.map(lambda w, c: w - c, weights, client_params)
+            (grads,) = vjp_fn(delta_theta)
+            updates, new_opt_s = tx.update(grads, opt_s, hp)
+            new_hp = optax.apply_updates(hp, updates)
+            hp = jax.tree.map(lambda n, o: jnp.where(active, n, o), new_hp, hp)
+            opt_s = jax.tree.map(lambda n, o: jnp.where(active, n, o), new_opt_s, opt_s)
+            return (hp, opt_s), None
+
+        xs = (jnp.arange(num_clients), active_mask.astype(bool))
+        (hnet_params, opt_state), _ = jax.lax.scan(body, (hnet_params, opt_state), xs)
+        return hnet_params, opt_state
+
+    return hyper_update, tx

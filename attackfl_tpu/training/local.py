@@ -1,0 +1,173 @@
+"""Per-client local training as a pure JAX function.
+
+The reference's client hot loop — E epochs of minibatch Adam per client
+process (client.py:66-131, driven from RpcClient.genuine_training,
+src/RpcClient.py:147-172) — becomes one pure function
+``local_update(params, rng, idx, mask)`` compiled once and ``vmap``-ed over
+the stacked client axis.  Epoch and batch loops are ``lax.scan``s; batches
+are fixed-shape gathers from the device-resident dataset, so N clients'
+training runs as one fused batched-matmul program on the MXU.
+
+Divergences from the reference (intentional fixes, SURVEY.md §2 quirks):
+* gradient clipping is applied to *real* gradients via optax; the reference
+  calls clip_grad_norm_ before backward() so it clipped zeros
+  (client.py:104-106);
+* batches of size 1 are handled by masking instead of being skipped
+  (client.py:86-87) — no BatchNorm anywhere, so size-1 batches are safe;
+* the NaN tripwire (client.py:100-102) is a carried boolean instead of an
+  early return (single round outcome is identical: the round is rejected).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Batch = dict[str, jnp.ndarray]
+
+
+def make_loss_fn(model, data_name: str) -> Callable:
+    """Per-batch masked mean loss.
+
+    ICU -> BCE on sigmoid outputs (client.py:77), HAR -> softmax CE on
+    logits (client.py:117), CIFAR10 -> NLL on log-prob outputs (the
+    validation contract, src/Validation.py:76).
+    """
+
+    if data_name == "ICU":
+
+        def loss_fn(params, batch: Batch, mask, rng):
+            probs = model.apply(
+                {"params": params}, batch["vitals"], batch["labs"], train=True,
+                rngs={"dropout": rng},
+            )[:, 0]
+            probs = jnp.clip(probs, 1e-7, 1.0 - 1e-7)
+            y = batch["label"]
+            per = -(y * jnp.log(probs) + (1.0 - y) * jnp.log(1.0 - probs))
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    elif data_name == "HAR":
+
+        def loss_fn(params, batch: Batch, mask, rng):
+            logits = model.apply(
+                {"params": params}, batch["x"], train=True, rngs={"dropout": rng}
+            )
+            per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    elif data_name == "CIFAR10":
+
+        def loss_fn(params, batch: Batch, mask, rng):
+            logp = model.apply(
+                {"params": params}, batch["x"], train=True, rngs={"dropout": rng}
+            )
+            per = -jnp.take_along_axis(logp, batch["label"][:, None], axis=1)[:, 0]
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    else:
+        raise ValueError(f"Data name '{data_name}' is not valid.")
+
+    return loss_fn
+
+
+def make_optimizer(lr: float, clip_grad_norm: float) -> optax.GradientTransformation:
+    """Adam with torch-default hyperparameters (client.py:78,116) behind an
+    optional global-norm clip (config.yaml:37)."""
+    tx = []
+    if clip_grad_norm and clip_grad_norm > 0:
+        tx.append(optax.clip_by_global_norm(clip_grad_norm))
+    tx.append(optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8))
+    return optax.chain(*tx)
+
+
+def build_local_update(
+    model,
+    data_name: str,
+    dataset: Batch,
+    *,
+    epochs: int,
+    batch_size: int,
+    lr: float,
+    clip_grad_norm: float,
+) -> Callable:
+    """Build ``local_update(params, rng, idx, mask) -> (params, ok, loss)``.
+
+    ``idx`` (hi,) are padded sample indices into ``dataset``; ``mask`` (hi,)
+    marks which are real.  The optimizer is created fresh per call,
+    mirroring the per-round Adam construction in the reference
+    (client.py:78).  vmap over the leading client axis with
+    ``in_axes=(0 or None, 0, 0, 0)``.
+    """
+    loss_fn = make_loss_fn(model, data_name)
+    tx = make_optimizer(lr, clip_grad_norm)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def local_update(params: Any, rng: jax.Array, idx: jnp.ndarray, mask: jnp.ndarray):
+        hi = idx.shape[0]
+        num_batches = -(-hi // batch_size)
+        pad = num_batches * batch_size - hi
+        opt_state = tx.init(params)
+
+        def epoch_step(carry, ek):
+            params, opt_state, ok = carry
+            k_perm, k_drop = jax.random.split(ek)
+            perm = jax.random.permutation(k_perm, hi)
+            bidx = jnp.pad(idx[perm], (0, pad)).reshape(num_batches, batch_size)
+            bmask = jnp.pad(mask[perm], (0, pad)).reshape(num_batches, batch_size)
+            dropout_keys = jax.random.split(k_drop, num_batches)
+
+            def batch_step(carry, xs):
+                params, opt_state, ok = carry
+                bi, bm, dk = xs
+                batch = {k: v[bi] for k, v in dataset.items()}
+                loss, grads = grad_fn(params, batch, bm.astype(jnp.float32), dk)
+                ok = ok & jnp.isfinite(loss)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, ok), loss
+
+            (params, opt_state, ok), losses = jax.lax.scan(
+                batch_step, (params, opt_state, ok), (bidx, bmask, dropout_keys)
+            )
+            return (params, opt_state, ok), jnp.mean(losses)
+
+        ok0 = jnp.asarray(True)
+        (params, _, ok), epoch_losses = jax.lax.scan(
+            epoch_step, (params, opt_state, ok0), jax.random.split(rng, epochs)
+        )
+        return params, ok, epoch_losses[-1]
+
+    return local_update
+
+
+def build_root_update(
+    model,
+    data_name: str,
+    root_data: Batch,
+    *,
+    epochs: int,
+    batch_size: int,
+    lr: float,
+    clip_grad_norm: float,
+) -> Callable:
+    """FLTrust server-side root training (reference: server.py:290-293,711
+    — the server runs the same ``train_on_device`` on the first 200 test
+    samples, batch 100, unshuffled).  Returns ``root_update(params, rng) ->
+    params`` over the full fixed root set."""
+    n = next(iter(root_data.values())).shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    mask = jnp.ones((n,), dtype=bool)
+    inner = build_local_update(
+        model, data_name, root_data,
+        epochs=epochs, batch_size=batch_size, lr=lr, clip_grad_norm=clip_grad_norm,
+    )
+
+    def root_update(params, rng):
+        new_params, _ok, _loss = inner(params, rng, idx, mask)
+        return new_params
+
+    return root_update

@@ -1,0 +1,213 @@
+"""The federated round as one jitted program.
+
+The reference's round is a distributed protocol: broadcast START over AMQP,
+N processes train, UPDATE messages accumulate at a barrier, then the server
+aggregates (server.py:205-275 → process_consumer server.py:277-567).  Here
+the same semantics compile to a single XLA program over the stacked client
+axis:
+
+    sample data → vmap(local_update) → overwrite attacker rows with
+    attack(prev-round genuine leak) → collect new genuine set → aggregate.
+
+Key fidelity points:
+* Attackers do NOT train in attack rounds: their update is computed from
+  the globally broadcast params + the genuine models leaked from the
+  *previous* round (the server accumulates genuine UPDATEs each round and
+  ships a sample inside the next START — server.py:259-268,596-616;
+  clients attack instead of training at RpcClient.py:100-104).  Before any
+  genuine set exists (round 1) attackers train genuinely.
+* Each attacker receives its own leak sample of size
+  max(int(genuine_rate·G), 1) drawn without replacement (server.py:599-600).
+* Attack activation is per-broadcast (the client counts STARTs,
+  RpcClient.py:72), so retried rounds advance the attack clock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.data.partition import sample_round_indices
+from attackfl_tpu.ops import aggregators, attacks
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.training.local import build_local_update, build_root_update
+
+Batch = dict[str, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class AttackGroup:
+    """Static attacker geometry for one attack spec."""
+
+    mode: str
+    indices: tuple[int, ...]
+    attack_round: int
+    args: tuple[float, ...]
+
+
+def build_attack_groups(cfg: Config) -> tuple[list[AttackGroup], list[int]]:
+    """Resolve config attack specs into (groups, genuine client indices)."""
+    assignment = cfg.attacker_assignment()
+    groups: dict[int, AttackGroup] = {}
+    by_spec: dict[int, list[int]] = {}
+    specs: dict[int, Any] = {}
+    for cid, spec in assignment.items():
+        key = id(spec)
+        by_spec.setdefault(key, []).append(cid)
+        specs[key] = spec
+    group_list = [
+        AttackGroup(
+            mode=specs[k].mode,
+            indices=tuple(sorted(ids)),
+            attack_round=specs[k].attack_round,
+            args=tuple(specs[k].args),
+        )
+        for k, ids in by_spec.items()
+    ]
+    genuine = sorted(set(range(cfg.total_clients)) - set(assignment))
+    return group_list, genuine
+
+
+def build_round_step(
+    model,
+    cfg: Config,
+    train_data: Batch,
+    attack_groups: Sequence[AttackGroup],
+    genuine_idx: Sequence[int],
+    client_pools: jnp.ndarray | None = None,
+    constrain: Callable | None = None,
+) -> Callable:
+    """Build ``round_step(global_params, prev_genuine, have_genuine, rng,
+    broadcast_number) -> (stacked, sizes, new_genuine, ok, mean_loss)``.
+
+    ``constrain`` (from parallel.mesh.make_constrain) pins stacked
+    per-client tensors to the client mesh axis inside jit, sharding the
+    vmapped local-training compute across devices.
+
+    ``prev_genuine`` is the stacked tree of the G genuine clients' previous
+    updates; ``have_genuine`` is False until one round has completed.
+    The result is mode-agnostic: aggregation is a separate jitted function
+    so host-side defenses (GMM / FLTracer) can filter in between.
+    """
+    num_clients = cfg.total_clients
+    lo, hi = cfg.num_data_range
+    pool = next(iter(train_data.values())).shape[0]
+    num_genuine = len(genuine_idx)
+    leak_k = max(int(cfg.genuine_rate * num_genuine), 1)
+    genuine_arr = jnp.asarray(genuine_idx, dtype=jnp.int32)
+
+    local_update = build_local_update(
+        model, cfg.data_name, train_data,
+        epochs=cfg.epochs, batch_size=cfg.batch_size,
+        lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
+    )
+    constrain = constrain or (lambda tree: tree)
+
+    def round_step(global_params, prev_genuine, have_genuine, rng, broadcast_number):
+        k_data, k_train, k_attack = jax.random.split(rng, 3)
+        idx, mask, sizes = sample_round_indices(
+            k_data, num_clients, pool, lo, hi, client_pools
+        )
+        idx, mask = constrain(idx), constrain(mask)
+        train_keys = constrain(jax.random.split(k_train, num_clients))
+        stacked, ok, losses = jax.vmap(local_update, in_axes=(None, 0, 0, 0))(
+            global_params, train_keys, idx, mask
+        )
+        stacked = constrain(stacked)
+
+        for gi, grp in enumerate(attack_groups):
+            n_attackers = len(grp.indices)
+            keys = jax.random.split(jax.random.fold_in(k_attack, gi), n_attackers)
+            active = (broadcast_number >= grp.attack_round) & have_genuine
+
+            def attack_one(key):
+                k_leak, k_noise = jax.random.split(key)
+                leak = jax.random.choice(
+                    k_leak, num_genuine, (min(leak_k, num_genuine),), replace=False
+                )
+                leaked = pt.tree_take(prev_genuine, leak)
+                return attacks.apply_attack(
+                    grp.mode, global_params, leaked, k_noise, grp.args
+                )
+
+            attacked = jax.vmap(attack_one)(keys)
+            grp_arr = jnp.asarray(grp.indices)
+
+            def scatter(s, a):
+                new_rows = jnp.where(active, a, s[grp_arr])
+                return s.at[grp_arr].set(new_rows)
+
+            stacked = jax.tree.map(scatter, stacked, attacked)
+            # attackers that attacked did not train; their NaN status resets
+            ok = ok.at[grp_arr].set(jnp.where(active, True, ok[grp_arr]))
+
+        new_genuine = pt.tree_take(stacked, genuine_arr)
+        return stacked, sizes, new_genuine, jnp.all(ok), jnp.mean(losses)
+
+    return round_step
+
+
+def build_aggregator(
+    model,
+    cfg: Config,
+    test_data: Batch | None,
+) -> Callable:
+    """Build ``aggregate(global_params, stacked, sizes, weights_mask, rng)
+    -> new_global`` for the configured mode.
+
+    ``weights_mask`` (C,) soft-excludes clients (host-side defense filters,
+    inactive clients); all-ones means everyone participates.
+    For "gmm" the reference averages survivors UNWEIGHTED
+    (avg_selected_parameters, server.py:777-797); every other weighted mode
+    uses sizes.
+    """
+    mode = cfg.mode
+
+    if mode == "fedavg" or mode == "fltracer":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.fedavg(stacked, sizes.astype(jnp.float32) * weights_mask)
+    elif mode == "gmm":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return pt.tree_weighted_mean(stacked, weights_mask)
+    elif mode == "median":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.median_aggregation(stacked)
+    elif mode == "trimmed_mean":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.trimmed_mean(stacked, cfg.trim_ratio)
+    elif mode == "krum":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.krum(stacked, cfg.krum_f)
+    elif mode == "shieldfl":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.shieldfl(stacked)
+    elif mode == "scionfl":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.scionfl(stacked, sizes.astype(jnp.float32) * weights_mask, rng)
+    elif mode == "FLTrust":
+        if test_data is None:
+            raise ValueError("FLTrust requires test data for root training")
+        # Root set: first 200 test samples, batch 100, unshuffled
+        # (server.py:290-293).
+        root = {k: jnp.asarray(v[:200]) for k, v in test_data.items()}
+        root_update = build_root_update(
+            model, cfg.data_name, root,
+            epochs=cfg.epochs, batch_size=100, lr=cfg.lr,
+            clip_grad_norm=cfg.clip_grad_norm,
+        )
+
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            root_params = root_update(global_params, rng)
+            root_delta = jax.tree.map(lambda a, b: a - b, root_params, global_params)
+            deltas = jax.tree.map(lambda s, g: s - g[None], stacked, global_params)
+            return aggregators.fltrust_combine(global_params, deltas, root_delta)
+    else:
+        raise ValueError(f"Server mode '{mode}' is not valid.")
+
+    return aggregate

@@ -1,0 +1,44 @@
+"""Checkpoint/resume.
+
+The reference torch.saves the global state_dict to ``{model}.pth`` (or
+``{model}_hyper_{N}.pth``) after every successful round and reloads at
+startup (server.py:144-163,549-553,578-586).  Equivalent here: the full
+simulation state — global/hyper params, optimizer state, round index, rng
+key and attack clock — serialized with flax msgpack to
+``{model}.msgpack`` / ``{model}_hyper_{N}.msgpack``.  Restoring requires a
+structurally matching template (same config), like torch load_state_dict.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def save_state(path: str, state: Any) -> None:
+    state = jax.device_get(state)
+    data = serialization.to_bytes(state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, path)
+
+
+def load_state(path: str, template: Any) -> Any:
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return serialization.from_bytes(template, data)
+
+
+def checkpoint_path(cfg, base_dir: str | None = None) -> str:
+    """Reference naming contract (server.py:145-146) with msgpack suffix."""
+    base = base_dir or cfg.checkpoint_dir
+    if cfg.mode == "hyper":
+        name = f"{cfg.model}_hyper_{cfg.total_clients}.msgpack"
+    else:
+        name = f"{cfg.model}.msgpack"
+    return os.path.join(base, name)
