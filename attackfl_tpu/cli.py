@@ -1,0 +1,140 @@
+"""CLI launchers with the reference's process UX, minus the broker.
+
+The reference is started as ``python server.py`` (waits for N clients on
+RabbitMQ) plus N ``python client.py [--attack ...]`` processes
+(README.md:91-143).  Here the simulation is in-process, but the same
+workflow is preserved through a file-based rendezvous: each ``client.py``
+invocation writes a registration (client id + attack flags) into
+``.registrations/`` and exits; ``server.py`` collects registrations until
+``server.clients`` are present (its registration wait, server.py:231) and
+then runs the whole federation on the TPU.  ``server.py --no-wait`` skips
+the rendezvous and reads attackers from the config's ``attack-clients``
+section instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+
+from attackfl_tpu.config import AttackSpec, Config, load_config
+from attackfl_tpu.utils.logging import print_with_color
+
+REG_DIR = ".registrations"
+
+
+def _registration_dir(base: str) -> str:
+    path = os.path.join(base, REG_DIR)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def client_main(argv=None) -> None:
+    """Reference client flags (client.py:19-38) -> registration file."""
+    parser = argparse.ArgumentParser(description="attackfl_tpu client launcher")
+    parser.add_argument("--config", type=str, default="config.yaml")
+    parser.add_argument("--device", type=str, required=False, help="accepted for parity; unused")
+    parser.add_argument("--attack", type=bool, required=False, default=False)
+    parser.add_argument("--attack_mode", type=str,
+                        choices=["Random", "Min-Max", "Min-Sum", "Opt-Fang", "LIE"])
+    parser.add_argument("--attack_round", type=int)
+    parser.add_argument("--attack_args", type=float, nargs="+")
+    args = parser.parse_args(argv)
+
+    if args.attack and not args.attack_mode:
+        print("Error: --attack_mode is required when --attack is True.")
+        sys.exit(1)
+    if args.attack and not args.attack_round:
+        print("Error: --attack_round is required when --attack is True.")
+        sys.exit(1)
+
+    client_id = str(uuid.uuid4())
+    reg = {
+        "client_id": client_id,
+        "attack": bool(args.attack),
+        "attack_mode": args.attack_mode,
+        "attack_round": args.attack_round,
+        "attack_args": args.attack_args or [],
+    }
+    reg_dir = _registration_dir(os.path.dirname(os.path.abspath(args.config)))
+    path = os.path.join(reg_dir, f"{client_id}.json")
+    tmp = path + ".tmp"  # atomic publish: the server polls this directory
+    with open(tmp, "w") as fh:
+        json.dump(reg, fh)
+    os.replace(tmp, path)
+    print_with_color("[>>>] Client sending registration message to server...", "red")
+    print(f"Client ID: {client_id}")
+    print(f"Attack: {reg['attack']}, Mode: {reg['attack_mode']}")
+
+
+def _collect_registrations(cfg: Config, base: str, timeout: float = 600.0) -> list[dict]:
+    reg_dir = _registration_dir(base)
+    print_with_color(f"Server is waiting for {cfg.total_clients} clients.", "green")
+    deadline = time.time() + timeout
+    while True:
+        regs = []
+        for name in sorted(os.listdir(reg_dir)):
+            if name.endswith(".json"):
+                try:
+                    with open(os.path.join(reg_dir, name)) as fh:
+                        regs.append(json.load(fh))
+                except (json.JSONDecodeError, OSError):
+                    continue  # mid-write or vanished; retry next poll
+        if len(regs) >= cfg.total_clients:
+            for name in os.listdir(reg_dir):  # queue hygiene, cf. delete_old_queues
+                os.unlink(os.path.join(reg_dir, name))
+            return regs[: cfg.total_clients]
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"only {len(regs)}/{cfg.total_clients} clients registered"
+            )
+        time.sleep(0.5)
+
+
+def _attacks_from_registrations(regs: list[dict]) -> tuple[AttackSpec, ...]:
+    specs = []
+    for i, reg in enumerate(regs):
+        if reg.get("attack"):
+            specs.append(AttackSpec(
+                mode=reg["attack_mode"],
+                client_ids=(i,),
+                attack_round=int(reg["attack_round"] or 1),
+                args=tuple(reg.get("attack_args") or []),
+            ))
+    return tuple(specs)
+
+
+def server_main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Federated learning framework with controller."
+    )
+    parser.add_argument("--config", type=str, default="config.yaml")
+    parser.add_argument("--device", type=str, required=False,
+                        help="jax platform override (tpu/cpu); default = auto")
+    parser.add_argument("--no-wait", action="store_true",
+                        help="skip client rendezvous; attackers come from config")
+    parser.add_argument("--rounds", type=int, default=None, help="override num-round")
+    args = parser.parse_args(argv)
+
+    if args.device:
+        import jax
+        jax.config.update("jax_platforms", args.device)
+
+    cfg = load_config(args.config)
+    base = os.path.dirname(os.path.abspath(args.config))
+
+    if not args.no_wait:
+        regs = _collect_registrations(cfg, base)
+        print_with_color("All clients are connected. Sending notifications.", "green")
+        cfg = cfg.replace(attacks=_attacks_from_registrations(regs))
+
+    from attackfl_tpu.training.engine import Simulator
+
+    sim = Simulator(cfg, use_mesh=True)
+    state, history = sim.run(num_rounds=args.rounds)
+    ok_rounds = sum(1 for h in history if h["ok"])
+    print_with_color(f"Finished: {ok_rounds} successful rounds.", "green")

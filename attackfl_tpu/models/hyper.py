@@ -24,14 +24,17 @@ import jax
 import jax.numpy as jnp
 
 
-def _path_name(path) -> str:
-    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+from attackfl_tpu.ops.pytree import path_name
 
 
 def target_spec(template_params: Any) -> tuple[tuple[str, tuple[int, ...]], ...]:
-    """Hashable (name, shape) spec for every leaf of a target param pytree."""
+    """Hashable (name, shape) spec for every leaf of a target param pytree.
+
+    Head names are the canonical leaf path with "/" sanitized to "__" —
+    the same name mangling the reference applies to state_dict keys
+    (src/Model.py:277)."""
     flat = jax.tree_util.tree_flatten_with_path(template_params)[0]
-    return tuple((_path_name(p).replace("/", "__"), tuple(leaf.shape)) for p, leaf in flat)
+    return tuple((path_name(p).replace("/", "__"), tuple(leaf.shape)) for p, leaf in flat)
 
 
 class HyperNetwork(nn.Module):

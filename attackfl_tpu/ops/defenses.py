@@ -38,17 +38,24 @@ def gmm_filter(
 ) -> np.ndarray:
     """Return a benign-client boolean mask.
 
-    Reference semantics (server.py:352-372): fit a 2-component
-    full-covariance GMM on all flat client updates using *ground-truth*
-    attacker labels only to compute the threshold — 3×std of the benign
-    clients' Mahalanobis distances to component 0 — then keep clients whose
-    distance to their argmax component is within the threshold.
+    Reference semantics (server.py:352-372 + src/Utils.py:257-323): fit a
+    2-component full-covariance GMM on all flat client updates (using the
+    *ground-truth* attacker labels to calibrate a Mahalanobis threshold
+    from the benign population) and keep clients within the threshold.
 
-    Divergence (documented): the reference fits a PxP covariance on a
-    handful of P≈10⁴⁺-dim vectors, which is singular and O(P²) memory —
-    computationally infeasible as written.  We first project to
-    ``min(n_clients-1, max_dim)`` PCA dims, preserving the decision
-    structure at tractable cost.
+    Divergences (documented fixes — the reference recipe is inoperative as
+    written):
+    * The reference fits a PxP covariance on a handful of P≈10⁴⁺-dim
+      vectors — singular and O(P²) memory.  We first project to
+      ``min(n_clients-1, max_dim)`` PCA dims.
+    * The reference thresholds each client's distance to its OWN argmax
+      component (Utils.py:311-323) — attackers clustered into their own
+      component always sit near that component's mean and always pass; and
+      its threshold (3·std of benign distances to hardcoded component 0,
+      server.py:361) depends on arbitrary component ordering.  We measure
+      every client against the benign-majority component and use
+      mean + md_sigma·std of the benign distances as the cutoff, which
+      makes the filter actually reject poisoned updates.
     """
     x = np.asarray(client_vectors, dtype=np.float64)
     attacker_mask = np.asarray(attacker_mask, dtype=bool)
@@ -56,21 +63,20 @@ def gmm_filter(
     k = max(1, min(n - 1, max_dim))
     z = pca_fit_transform(x, k)
 
-    benign = z[~attacker_mask]
     gmm = GaussianMixture(n_components=n_components, seed=seed).fit(z)
+    hard = gmm.predict_proba(z).argmax(axis=1)
 
-    benign_md = np.array(
-        [mahalanobis(g, gmm.means_[0], gmm.covariances_[0]) for g in benign]
-    )
-    threshold = md_sigma * float(np.std(benign_md))
+    benign_idx = np.flatnonzero(~attacker_mask)
+    counts = np.bincount(hard[benign_idx], minlength=n_components)
+    benign_comp = int(np.argmax(counts))
+    mean_b = gmm.means_[benign_comp]
+    cov_b = gmm.covariances_[benign_comp]
 
-    keep = np.zeros(n, dtype=bool)
-    probs = gmm.predict_proba(z)
-    for i in range(n):
-        cluster = int(np.argmax(probs[i]))
-        md = mahalanobis(z[i], gmm.means_[cluster], gmm.covariances_[cluster])
-        keep[i] = md <= threshold
-    return keep
+    benign_md = np.array([mahalanobis(z[i], mean_b, cov_b) for i in benign_idx])
+    threshold = float(np.mean(benign_md)) + md_sigma * float(np.std(benign_md))
+
+    md = np.array([mahalanobis(z[i], mean_b, cov_b) for i in range(n)])
+    return md <= threshold
 
 
 # ---------------------------------------------------------------------------

@@ -223,11 +223,19 @@ def tree_weighted_mean(stacked: Pytree, weights: jnp.ndarray) -> Pytree:
     return jax.tree.map(wmean, stacked)
 
 
+def path_name(path) -> str:
+    """Canonical leaf name from a tree_util key path ("a/b/kernel").
+
+    Part of the hypernetwork head-naming and checkpoint contract — keep the
+    single definition here.
+    """
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
 def tree_map_with_path_names(fn: Callable[[str, jnp.ndarray], jnp.ndarray], tree: Pytree) -> Pytree:
     """Map with a dotted path name per leaf (registry-style names)."""
 
     def _fn(path, leaf):
-        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        return fn(name, leaf)
+        return fn(path_name(path), leaf)
 
     return jax.tree_util.tree_map_with_path(_fn, tree)

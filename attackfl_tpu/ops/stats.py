@@ -57,10 +57,11 @@ class GaussianMixture:
     """Minimal full-covariance GMM with the sklearn attributes the defense
     layer needs: ``means_``, ``covariances_``, ``predict_proba``.
 
-    kmeans++-free init: responsibilities start from a random hard
-    assignment.  ``reg_covar`` keeps covariances invertible exactly like
-    sklearn's regularization (needed because the reference fits P-dim
-    covariances on a handful of client vectors).
+    Init: means seeded from k distinct random data points, points hard-
+    assigned to the nearest mean (one k-means-like step), then EM.
+    ``reg_covar`` keeps covariances invertible exactly like sklearn's
+    regularization (needed because the reference fits P-dim covariances on
+    a handful of client vectors).
     """
 
     def __init__(self, n_components: int = 2, n_iter: int = 50,
@@ -77,8 +78,15 @@ class GaussianMixture:
         x = np.asarray(x, dtype=np.float64)
         n, d = x.shape
         rng = np.random.default_rng(self.seed)
-        # init responsibilities from random assignment (ensure non-empty)
-        assign = rng.integers(0, self.n_components, size=n)
+        # seed means from distinct data points, hard-assign to nearest
+        seeds = rng.choice(n, size=min(self.n_components, n), replace=False)
+        centers = x[seeds]
+        if centers.shape[0] < self.n_components:  # fewer points than comps
+            centers = np.concatenate(
+                [centers, centers[: self.n_components - centers.shape[0]] + 1e-3]
+            )
+        dists = np.linalg.norm(x[:, None, :] - centers[None, :, :], axis=-1)
+        assign = dists.argmin(axis=1)
         for k in range(self.n_components):
             if not np.any(assign == k):
                 assign[rng.integers(n)] = k

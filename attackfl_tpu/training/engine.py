@@ -237,7 +237,7 @@ class Simulator:
             jnp.asarray(bool(state["have_genuine"])), k_round,
             jnp.asarray(broadcast_number),
         )
-        ok = bool(ok)
+        ok = train_ok = bool(ok)
         metrics["train_loss"] = float(loss)
 
         weights_mask = jnp.ones((cfg.total_clients,), jnp.float32)
@@ -272,8 +272,14 @@ class Simulator:
         new_state = dict(state)
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
-        new_state["prev_genuine"] = new_genuine
-        new_state["have_genuine"] = np.asarray(True)
+        # The genuine-leak cache only absorbs rounds whose *training* was
+        # clean: the reference gates accumulation on the per-client result
+        # flag (server.py:245,260-268), so a NaN round never contaminates
+        # the leak pool.  Validation-failed rounds DO leak (the reference
+        # re-broadcasts the already-accumulated list, server.py:596-616).
+        if train_ok:
+            new_state["prev_genuine"] = new_genuine
+            new_state["have_genuine"] = np.asarray(True)
         if ok:
             new_state["global_params"] = new_global
             new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
@@ -287,7 +293,7 @@ class Simulator:
             jnp.asarray(bool(state["have_genuine"])), active_mask, k_round,
             jnp.asarray(broadcast_number),
         )
-        ok = bool(ok)
+        ok = train_ok = bool(ok)
         metrics["train_loss"] = float(loss)
 
         # snapshot for detection rollback (reference: server.py:296-298)
@@ -329,8 +335,9 @@ class Simulator:
         new_state = dict(state)
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
-        new_state["prev_genuine"] = new_genuine
-        new_state["have_genuine"] = np.asarray(True)
+        if train_ok:  # NaN rounds must not contaminate the leak pool
+            new_state["prev_genuine"] = new_genuine
+            new_state["have_genuine"] = np.asarray(True)
         new_state["active_mask"] = new_active
         if ok:
             new_state["hnet_params"] = hnet_params

@@ -102,14 +102,30 @@ def build_hyper_round(
         )
         stacked = constrain(stacked)
 
-        # genuine-leak eligibility: only active genuine clients can be leaked
+        # Genuine-leak eligibility: only active genuine clients may be
+        # leaked.  The sample size is static but the active pool can shrink
+        # below it (detector removals), so when the detector is enabled
+        # sampling is WITH replacement over the eligibility distribution —
+        # duplicates only slightly sharpen the attack statistics, while
+        # without-replacement would be forced to pick zero-probability
+        # (removed) clients.  With the detector off the pool is fixed and
+        # sampling is without replacement, matching the reference's
+        # random.sample (server.py:599).  If no genuine client is active at
+        # all, attacks are disabled entirely (the reference's
+        # empty-leak-list case, RpcClient.py:100).
         active_genuine = active_mask[genuine_arr].astype(jnp.float32)
-        leak_p = active_genuine / jnp.maximum(jnp.sum(active_genuine), 1.0)
+        n_active_genuine = jnp.sum(active_genuine)
+        any_active_genuine = n_active_genuine > 0
+        leak_p = active_genuine / jnp.maximum(n_active_genuine, 1.0)
 
         for gi, grp in enumerate(attack_groups):
             n_attackers = len(grp.indices)
             keys = jax.random.split(jax.random.fold_in(k_attack, gi), n_attackers)
-            active = (broadcast_number >= grp.attack_round) & have_genuine
+            active = (
+                (broadcast_number >= grp.attack_round)
+                & have_genuine
+                & any_active_genuine
+            )
             grp_arr = jnp.asarray(grp.indices)
             own_params = pt.tree_take(broadcast_params, grp_arr)
 
@@ -117,7 +133,7 @@ def build_hyper_round(
                 k_leak, k_noise = jax.random.split(key)
                 leak = jax.random.choice(
                     k_leak, num_genuine, (min(leak_k, num_genuine),),
-                    replace=False, p=leak_p,
+                    replace=cfg.hyper_detection.enable, p=leak_p,
                 )
                 leaked = pt.tree_take(prev_genuine, leak)
                 return attacks.apply_attack(grp.mode, own, leaked, k_noise, grp.args)

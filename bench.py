@@ -1,0 +1,78 @@
+"""Benchmark: FL rounds/sec on the BASELINE.md headline configuration.
+
+Workload (BASELINE.json config 4 family): ICU TransformerModel, 100
+clients, FedAvg, LIE attackers at genuine-rate 0.5, full reference
+hyperparameters (5 local epochs, batch 128, 12k-15k samples/client/round —
+config.yaml:17-20,31-37), validation on.  The entire round — per-client
+Adam training vmapped over the client axis, attack synthesis, weighted
+aggregation, ROC-AUC validation — runs as jitted XLA programs on the TPU.
+
+Prints ONE JSON line:
+  {"metric": "fl_rounds_per_sec_100c", "value": N, "unit": "rounds/s",
+   "vs_baseline": N}
+
+vs_baseline is measured against the driver's north-star rate
+(1000 clients x 100 rounds in < 60 s on a v4-8 => 1.667 rounds/s;
+/root/repo/BASELINE.json) — the reference itself publishes no numbers
+(BASELINE.md), so the north star is the only quantitative anchor.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+NORTH_STAR_ROUNDS_PER_SEC = 100.0 / 60.0  # BASELINE.json north star
+
+
+def main() -> None:
+    from attackfl_tpu.config import AttackSpec, Config
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = Config(
+        num_round=5,
+        total_clients=100,
+        mode="fedavg",
+        model="TransformerModel",
+        data_name="ICU",
+        num_data_range=(12000, 15000),
+        epochs=5,
+        batch_size=128,
+        lr=0.004,
+        clip_grad_norm=1.0,
+        genuine_rate=0.5,
+        validation=True,
+        train_size=20000,
+        test_size=4000,
+        attacks=(AttackSpec(mode="LIE", num_clients=20, attack_round=2, args=(0.74,)),),
+        log_path="/tmp/attackfl_bench",
+    )
+    sim = Simulator(cfg)
+    state = sim.init_state()
+
+    # warmup: compile + first round (excluded from timing)
+    state, metrics = sim.run_round(state)
+    assert metrics["ok"], f"warmup round failed: {metrics}"
+
+    n_rounds = 4
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        state, metrics = sim.run_round(state)
+    elapsed = time.perf_counter() - t0
+    rounds_per_sec = n_rounds / elapsed
+
+    print(json.dumps({
+        "metric": "fl_rounds_per_sec_100c",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/s",
+        "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 4),
+        "detail": {
+            "config": "ICU TransformerModel, 100 clients, FedAvg + 20 LIE attackers",
+            "roc_auc_final": round(float(metrics.get("roc_auc", float("nan"))), 4),
+            "seconds_per_round": round(elapsed / n_rounds, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Client launcher — the reference's ``python client.py [--attack ...]``
+UX (reference: client.py:134-143) as a rendezvous registration."""
+
+from attackfl_tpu.cli import client_main
+
+if __name__ == "__main__":
+    client_main()

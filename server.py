@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Server launcher — the reference's ``python server.py`` UX
+(reference: server.py:838-842) over the in-process TPU simulation."""
+
+from attackfl_tpu.cli import server_main
+
+if __name__ == "__main__":
+    server_main()

@@ -1,0 +1,39 @@
+"""Test harness: CPU backend with a virtual 8-device mesh.
+
+The image's sitecustomize pins JAX_PLATFORMS=axon (the TPU tunnel), so the
+platform override must happen in-process before first backend use.  All
+multi-device sharding tests run against the fake CPU mesh (SURVEY.md §4).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture()
+def np_rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _isolate_artifacts(tmp_path_factory):
+    """Keep app.log / checkpoints / embeddings out of the repo root."""
+    workdir = tmp_path_factory.mktemp("artifacts")
+    old = os.getcwd()
+    os.chdir(workdir)
+    yield
+    os.chdir(old)

@@ -1,0 +1,126 @@
+"""Aggregator correctness: closed-form expectations and robustness
+properties (SURVEY.md §4 test strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.ops import aggregators as agg
+from attackfl_tpu.ops import pytree as pt
+
+
+def stacked_tree(n=5, seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.normal(size=(n, 3, 2)).astype(np.float32)),
+        "b": jnp.asarray(r.normal(size=(n, 4)).astype(np.float32)),
+    }
+
+
+def test_fedavg_weighted_exact():
+    t = stacked_tree(4)
+    sizes = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+    out = agg.fedavg(t, sizes)
+    arr = np.asarray(t["w"])
+    expected = (arr * np.array([10, 20, 30, 40])[:, None, None]).sum(0) / 100
+    np.testing.assert_allclose(np.asarray(out["w"]), expected, rtol=1e-5)
+
+
+def test_median_matches_torch_semantics():
+    """torch.median returns the LOWER middle element for even counts
+    (reference: src/Utils.py:356)."""
+    t = stacked_tree(4)
+    out = agg.median_aggregation(t)
+    arr = np.sort(np.asarray(t["w"]), axis=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), arr[1], rtol=1e-6)  # (4-1)//2 = 1
+    t5 = stacked_tree(5)
+    out5 = agg.median_aggregation(t5)
+    np.testing.assert_allclose(
+        np.asarray(out5["w"]), np.median(np.asarray(t5["w"]), axis=0), rtol=1e-6
+    )
+
+
+def test_trimmed_mean_bounds_and_math():
+    t = stacked_tree(10)
+    out = agg.trimmed_mean(t, 0.2)  # k=2
+    arr = np.sort(np.asarray(t["w"]), axis=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), arr[2:8].mean(0), rtol=1e-5)
+    # bounded by client extremes
+    assert np.all(np.asarray(out["w"]) >= arr[0] - 1e-6)
+    assert np.all(np.asarray(out["w"]) <= arr[-1] + 1e-6)
+    with pytest.raises(ValueError):
+        agg.trimmed_mean(stacked_tree(4), 0.5)  # k=2, 2k >= n
+
+
+def test_krum_returns_member_and_rejects_outlier():
+    r = np.random.default_rng(0)
+    base = r.normal(size=(1, 6)).astype(np.float32)
+    clients = np.repeat(base, 6, 0) + 0.01 * r.normal(size=(6, 6)).astype(np.float32)
+    clients[2] += 50.0  # outlier
+    t = {"w": jnp.asarray(clients)}
+    sel = int(agg.krum_select(t, f=1))
+    assert sel != 2
+    out = agg.krum(t, f=1)
+    np.testing.assert_allclose(np.asarray(out["w"]), clients[sel])  # member of input set
+
+
+def test_krum_scores_match_reference_formula():
+    """score_i = sum of n-f-2 smallest squared distances (Utils.py:336-339)."""
+    r = np.random.default_rng(1)
+    clients = r.normal(size=(5, 7)).astype(np.float32)
+    t = {"w": jnp.asarray(clients)}
+    f = 1
+    scores = []
+    for i in range(5):
+        d = sorted(np.sum((clients[i] - clients[j]) ** 2) for j in range(5) if j != i)
+        scores.append(sum(d[: 5 - f - 2]))
+    assert int(agg.krum_select(t, f)) == int(np.argmin(scores))
+
+
+def test_shieldfl_prefers_consensus():
+    r = np.random.default_rng(0)
+    base = r.normal(size=(1, 8)).astype(np.float32)
+    clients = np.repeat(base, 5, 0) + 0.01 * r.normal(size=(5, 8)).astype(np.float32)
+    clients[4] = -clients[4]  # direction-flipped client
+    t = {"w": jnp.asarray(clients)}
+    out = np.asarray(agg.shieldfl(t)["w"])
+    # result should be much closer to the consensus than to the flipped one
+    assert np.linalg.norm(out - clients[0]) < np.linalg.norm(out - clients[4])
+
+
+def test_scionfl_runs_and_filters():
+    t = stacked_tree(8, seed=2)
+    sizes = jnp.ones((8,))
+    out = agg.scionfl(t, sizes, jax.random.PRNGKey(0))
+    assert np.all(np.isfinite(np.asarray(out["w"])))
+
+
+def test_scionfl_quantization_roundtrip():
+    vec = jnp.asarray(np.random.default_rng(0).normal(size=(1000,)).astype(np.float32))
+    sigma, smin, smax = agg.quantize_vector(jax.random.PRNGKey(1), vec)
+    assert set(np.unique(np.asarray(sigma))).issubset({0.0, 1.0})
+    deq = agg.dequantize(sigma, smin, smax)
+    # dequantized values live on {smin, smax}; expectation preserves mean
+    assert abs(float(jnp.mean(deq)) - float(jnp.mean(vec))) < 0.1
+    l2 = float(agg.quantized_l2(sigma, smin, smax))
+    np.testing.assert_allclose(l2, float(jnp.linalg.norm(deq)), rtol=1e-4)
+
+
+def test_fltrust_combine_closed_form():
+    """Orthogonal client gets zero trust; aligned client gets scaled in."""
+    g = {"w": jnp.zeros((2,), jnp.float32)}
+    root_delta = {"w": jnp.asarray([1.0, 0.0])}
+    deltas = {"w": jnp.asarray([[2.0, 0.0],   # aligned, cos=1, norm 2 -> scaled to 1
+                                 [0.0, 3.0]])}  # orthogonal, trust 0
+    out = np.asarray(agg.fltrust_combine(g, deltas, root_delta)["w"])
+    # trust = [1, 0]; scaled update = (1/2)*[2,0]*1 = [1,0]; /sum_trust=1
+    np.testing.assert_allclose(out, [1.0, 0.0], atol=1e-4)
+
+
+def test_mean_aggregation():
+    t = stacked_tree(3)
+    out = agg.mean_aggregation(t)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.asarray(t["w"]).mean(0), rtol=1e-6
+    )

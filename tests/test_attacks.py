@@ -1,0 +1,143 @@
+"""Attack parity tests.
+
+The γ-search attacks are checked against a straight numpy transcription of
+the reference's loop semantics (src/Utils.py:101-214) — same binary search,
+same sum-of-per-leaf-norm distance — so the JAX while_loop implementation
+must reproduce the numpy trajectory bit-for-bit (up to float tolerance).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.ops import attacks
+from attackfl_tpu.ops import pytree as pt
+
+
+def make_models(n=4, seed=0):
+    r = np.random.default_rng(seed)
+    return [
+        {
+            "a": r.normal(size=(3, 2)).astype(np.float32),
+            "b": r.normal(size=(4,)).astype(np.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def to_stacked(models):
+    return jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *models)
+
+
+# ---- numpy oracle (reference loop semantics, non-aliasing variant) -------
+
+def np_distance(m1, m2):
+    return sum(np.linalg.norm((m1[k] - m2[k]).ravel()) for k in m1)
+
+
+def np_gamma_search(models, direction, constraint, gamma0=50.0, tau=1.0):
+    mean = {k: np.mean([m[k] for m in models], axis=0) for k in models[0]}
+    std = {k: np.std([m[k] for m in models], axis=0, ddof=1) for k in models[0]}
+    pert = std if direction == "std" else {k: np.sign(mean[k]) for k in mean}
+
+    if constraint == "minmax":
+        max_d = max(
+            np_distance(models[i], models[j])
+            for i in range(len(models))
+            for j in range(i + 1, len(models))
+        )
+
+        def accepts(cand):
+            return max(np_distance(cand, m) for m in models) < max_d
+
+    else:  # minsum
+        max_d = max(
+            sum(np_distance(models[i], models[j]) ** 2
+                for j in range(len(models)) if j != i)
+            for i in range(len(models))
+        )
+
+        def accepts(cand):
+            return sum(np_distance(cand, m) ** 2 for m in models) < max_d
+
+    gamma, gamma_succ, step = gamma0, 0.0, gamma0
+    last = gamma
+    while abs(gamma_succ - gamma) > tau:
+        last = gamma
+        cand = {k: mean[k] - gamma * pert[k] for k in mean}
+        if accepts(cand):
+            gamma_succ = gamma
+            gamma = gamma + step / 2
+        else:
+            gamma = gamma - step / 2
+        step = step / 2
+    return {k: mean[k] - last * pert[k] for k in mean}
+
+
+@pytest.mark.parametrize("mode,direction,constraint", [
+    ("Min-Max", "std", "minmax"),
+    ("Min-Sum", "std", "minsum"),
+    ("Opt-Fang", "sign", "minmax"),
+])
+def test_gamma_attacks_match_numpy_oracle(mode, direction, constraint):
+    models = make_models(5, seed=3)
+    stacked = to_stacked(models)
+    fn = {
+        "Min-Max": attacks.min_max_attack,
+        "Min-Sum": attacks.min_sum_attack,
+        "Opt-Fang": attacks.opt_fang_attack,
+    }[mode]
+    got = fn(stacked)
+    expected = np_gamma_search(models, direction, constraint)
+    for k in expected:
+        np.testing.assert_allclose(np.asarray(got[k]), expected[k], rtol=1e-4, atol=1e-4)
+
+
+def test_lie_closed_form():
+    models = make_models(6, seed=1)
+    stacked = to_stacked(models)
+    got = attacks.lie_attack(stacked, z=0.74)
+    for k in models[0]:
+        arr = np.stack([m[k] for m in models])
+        expected = arr.mean(0) + 0.74 * arr.std(0, ddof=1)
+        np.testing.assert_allclose(np.asarray(got[k]), expected, rtol=1e-5)
+
+
+def test_random_attack_statistics():
+    params = {"w": jnp.zeros((100, 100))}
+    out = attacks.random_attack(params, jax.random.PRNGKey(0), perturbation=2.0)
+    vals = np.asarray(out["w"]).ravel()
+    assert abs(vals.mean()) < 0.1
+    assert abs(vals.std() - 2.0) < 0.1
+
+
+def test_apply_attack_dispatch_and_degenerate_leak():
+    models = make_models(3)
+    stacked = to_stacked(models)
+    own = jax.tree.map(jnp.asarray, models[0])
+    key = jax.random.PRNGKey(0)
+    for mode in ("Random", "LIE", "Min-Max", "Min-Sum", "Opt-Fang"):
+        out = attacks.apply_attack(mode, own, stacked, key)
+        assert jax.tree.structure(out) == jax.tree.structure(own)
+    # single leaked model: gamma attacks return own params (Utils.py:102)
+    one = to_stacked(models[:1])
+    out = attacks.apply_attack("Min-Max", own, one, key)
+    np.testing.assert_array_equal(np.asarray(out["a"]), models[0]["a"])
+    with pytest.raises(ValueError):
+        attacks.apply_attack("Nope", own, stacked, key)
+
+
+def test_attacks_jit_and_vmap():
+    """Attacks must compile and batch over attackers (the round engine
+    vmaps attack_one over the attacker axis)."""
+    models = make_models(4)
+    stacked = to_stacked(models)
+
+    @jax.jit
+    def many(keys):
+        return jax.vmap(lambda k: attacks.min_max_attack(stacked))(keys)
+
+    out = many(jax.random.split(jax.random.PRNGKey(0), 3))
+    assert jax.tree.leaves(out)[0].shape[0] == 3
+    assert np.all(np.isfinite(np.asarray(out["a"])))

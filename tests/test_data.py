@@ -1,0 +1,81 @@
+"""Data layer: synthetic generators, round sampling, non-IID partition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.data.partition import dirichlet_label_partition, sample_round_indices
+from attackfl_tpu.data.synthetic import get_dataset, make_dataset
+
+
+def test_icu_shapes_and_signal():
+    d = make_dataset("ICU", 2000, seed=0)
+    assert d["vitals"].shape == (2000, 7)
+    assert d["labs"].shape == (2000, 16)
+    assert set(np.unique(d["label"])) == {0.0, 1.0}
+    rate = d["label"].mean()
+    assert 0.1 < rate < 0.5  # ~mortality base rate
+    # the mask sentinel appears (RNN masking path must be exercised)
+    assert np.any(d["vitals"] == -2.0)
+
+
+def test_har_shapes():
+    d = make_dataset("HAR", 500, seed=0)
+    assert d["x"].shape == (500, 561)
+    assert set(np.unique(d["label"])).issubset(set(range(6)))
+
+
+def test_cifar_shapes():
+    d = make_dataset("CIFAR10", 100, seed=0)
+    assert d["x"].shape == (100, 32, 32, 3)
+    assert d["x"].min() >= -1 and d["x"].max() <= 1
+
+
+def test_dataset_determinism_and_split_disjointness():
+    a = make_dataset("ICU", 100, seed=5)
+    b = make_dataset("ICU", 100, seed=5)
+    np.testing.assert_array_equal(a["vitals"], b["vitals"])
+    train = get_dataset("ICU", "train", 100, seed=1)
+    test = get_dataset("ICU", "test", 100, seed=1)
+    assert not np.allclose(train["vitals"], test["vitals"])
+
+
+def test_sample_round_indices_ranges():
+    idx, mask, sizes = sample_round_indices(jax.random.PRNGKey(0), 6, 1000, 50, 80)
+    assert idx.shape == (6, 80) and mask.shape == (6, 80) and sizes.shape == (6,)
+    s = np.asarray(sizes)
+    assert np.all((s >= 50) & (s <= 80))
+    m = np.asarray(mask)
+    np.testing.assert_array_equal(m.sum(1), s)  # mask consistent with sizes
+    # padded region is exactly the tail
+    for c in range(6):
+        assert m[c, : s[c]].all() and not m[c, s[c]:].any()
+    assert np.asarray(idx).max() < 1000 and np.asarray(idx).min() >= 0
+
+
+def test_dirichlet_partition_is_skewed_and_valid():
+    labels = np.random.default_rng(0).integers(0, 6, size=3000)
+    pools = dirichlet_label_partition(labels, num_clients=5, alpha=0.1, seed=0)
+    assert pools.shape[0] == 5
+    assert pools.max() < 3000
+    # strong skew: per-client label histograms differ a lot
+    hists = np.stack([np.bincount(labels[p], minlength=6) for p in pools])
+    fracs = hists / hists.sum(1, keepdims=True)
+    assert fracs.max() > 0.5  # at least one client dominated by one class
+
+
+def test_sampling_respects_client_pools():
+    labels = np.zeros(100, dtype=np.int64)
+    pools = np.tile(np.arange(10, 20, dtype=np.int32), (4, 5))[:, :50]  # clients only see 10..19
+    idx, mask, sizes = sample_round_indices(
+        jax.random.PRNGKey(1), 4, 100, 5, 8, client_pools=jnp.asarray(pools)
+    )
+    got = np.asarray(idx)
+    assert got.min() >= 10 and got.max() < 20
+
+
+def test_reference_pickle_fallback(tmp_path):
+    """Without reference blobs, get_dataset falls back to synthetic."""
+    d = get_dataset("HAR", "train", 64, seed=0)
+    assert d["x"].shape == (64, 561)

@@ -1,0 +1,80 @@
+"""Host-side defense layer: GMM filtering, FLTracer, hyper-detection."""
+
+import numpy as np
+
+from attackfl_tpu.ops import defenses
+
+
+def client_matrix(np_rng, n=10, p=40, outliers=()):
+    x = np_rng.normal(0, 0.1, size=(n, p))
+    for i in outliers:
+        x[i] += 25.0
+    return x
+
+
+def test_gmm_filter_drops_outliers(np_rng):
+    x = client_matrix(np_rng, outliers=(7, 8))
+    attacker_mask = np.zeros(10, dtype=bool)
+    attacker_mask[[7, 8]] = True
+    keep = defenses.gmm_filter(x, attacker_mask, seed=0)
+    assert keep[:7].all()
+    assert not keep[7] and not keep[8]
+
+
+def test_fltracer_flags_outlier(np_rng):
+    x = client_matrix(np_rng, outliers=(3,))
+    anomalies = defenses.fltracer_anomalies(x)
+    assert 3 in anomalies
+    assert len(anomalies) <= 2
+
+
+def test_cosine_drift_detects_direction_flip(np_rng):
+    history = np.tile(np.array([1.0, 1.0, 0.0, 0.0]), (6, 1))
+    history += np_rng.normal(0, 0.01, size=history.shape)
+    same = np.array([1.0, 1.0, 0.0, 0.0])
+    flipped = -same
+    assert not defenses.cosine_drift_anomaly(history, same)
+    assert defenses.cosine_drift_anomaly(history, flipped)
+    # empty history: never anomalous
+    assert not defenses.cosine_drift_anomaly(np.empty((0, 4)), same)
+
+
+def test_dbscan_outlier_clients(np_rng):
+    before = np_rng.normal(0, 0.001, size=(8, 5))
+    after = before + np_rng.normal(0, 0.0005, size=(8, 5))
+    after[6] += 5.0  # client 6's embedding jumped
+    out = defenses.dbscan_outlier_clients(
+        before, after, list(range(8)), n_components=3, eps=0.01, min_samples=3
+    )
+    assert out == [6]
+
+
+def test_hyper_detector_flow(tmp_path, np_rng):
+    det = defenses.HyperDetector(
+        total_clients=6, cosine_search=5, n_components=3, eps=0.05,
+        min_samples=3, start_round=3, save_path=str(tmp_path / "emb.npy"),
+    )
+    base = np_rng.normal(1.0, 0.01, size=(6, 8))
+    selected = list(range(6))
+    # rounds 1-2: record only, never flag
+    assert det.observe(1, selected, base) == []
+    assert det.observe(2, selected, base + 0.001) == []
+    # round 3: client 5 flips direction AND jumps -> flagged by both phases
+    bad = base + 0.001
+    bad[5] = -30.0 * base[5]
+    removed = det.observe(3, selected, bad)
+    assert removed == [5]
+    assert (tmp_path / "emb.npy").exists()
+
+
+def test_hyper_detector_intersection_semantics(np_rng):
+    """Removal requires BOTH phases to fire (reference: server.py:531)."""
+    det = defenses.HyperDetector(
+        total_clients=5, cosine_search=5, n_components=2, eps=1e9,  # dbscan never flags
+        min_samples=2, start_round=2, save_path=None,
+    )
+    base = np_rng.normal(1.0, 0.01, size=(5, 8))
+    det.observe(1, list(range(5)), base)
+    bad = base.copy()
+    bad[0] = -base[0]
+    assert det.observe(2, list(range(5)), bad) == []  # cosine fires, dbscan not

@@ -1,0 +1,94 @@
+"""End-to-end round-loop tests over the public Simulator API, one per
+BASELINE.md-style config family (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config, HyperDetectionConfig
+from attackfl_tpu.training.engine import Simulator
+from attackfl_tpu.utils import checkpoint as ckpt
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128, log_path=".", checkpoint_dir=".",
+)
+
+
+def test_fedavg_converges():
+    cfg = Config(num_round=3, total_clients=3, mode="fedavg", **BASE)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    assert hist[-1]["roc_auc"] > 0.65
+    assert hist[-1]["roc_auc"] >= hist[0]["roc_auc"] - 0.05
+
+
+def test_random_attack_defended_by_median():
+    atk = (AttackSpec(mode="Random", num_clients=1, attack_round=2, args=(1e6,)),)
+    cfg = Config(num_round=3, total_clients=5, mode="median", attacks=atk, **BASE)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    assert hist[-1]["roc_auc"] > 0.6
+
+
+def test_random_attack_poisons_fedavg():
+    """σ=1e6 noise through plain FedAvg must destroy the round (the
+    reference would retry forever; we cap and raise)."""
+    atk = (AttackSpec(mode="Random", num_clients=1, attack_round=2, args=(1e6,)),)
+    cfg = Config(num_round=3, total_clients=5, mode="fedavg", attacks=atk, **BASE)
+    with pytest.raises(RuntimeError, match="failed"):
+        Simulator(cfg).run(save_checkpoints=False, verbose=False)
+
+
+def test_lie_attack_runs_all_rounds():
+    atk = (AttackSpec(mode="LIE", num_clients=2, attack_round=2, args=(0.74,)),)
+    cfg = Config(num_round=3, total_clients=6, mode="trimmed_mean", attacks=atk,
+                 trim_ratio=0.2, **BASE)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+
+
+def test_checkpoint_resume(tmp_path):
+    base = dict(BASE)
+    base.update(log_path=str(tmp_path), checkpoint_dir=str(tmp_path))
+    cfg = Config(num_round=2, total_clients=3, mode="fedavg", **base)
+    sim = Simulator(cfg)
+    state, _ = sim.run(save_checkpoints=True, verbose=False)
+    assert int(state["completed_rounds"]) == 2
+
+    cfg2 = cfg.replace(load_parameters=True, num_round=4)
+    sim2 = Simulator(cfg2)
+    state2 = sim2.load_or_init_state()
+    assert int(state2["completed_rounds"]) == 2
+    state2, hist2 = sim2.run(state=state2, save_checkpoints=False, verbose=False)
+    assert int(state2["completed_rounds"]) == 4
+    assert len([h for h in hist2 if h["ok"]]) == 2  # only the remainder ran
+
+
+def test_non_iid_partition_runs():
+    cfg = Config(num_round=2, total_clients=4, mode="fedavg", partition="dirichlet",
+                 dirichlet_alpha=0.3, **BASE)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+
+
+def test_min_max_attack_with_defense_modes():
+    atk = (AttackSpec(mode="Min-Max", num_clients=1, attack_round=2),)
+    for mode in ("krum", "shieldfl"):
+        cfg = Config(num_round=2, total_clients=5, mode=mode, attacks=atk, **BASE)
+        _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+        assert all(h["ok"] for h in hist), mode
+
+
+@pytest.mark.slow
+def test_hyper_mode_with_detection():
+    cfg = Config(
+        num_round=3, total_clients=4, mode="hyper", model="TransformerModel",
+        data_name="ICU", num_data_range=(48, 64), epochs=1, batch_size=32,
+        train_size=256, test_size=128, log_path=".", checkpoint_dir=".",
+        attacks=(AttackSpec(mode="LIE", num_clients=1, attack_round=2),),
+        hyper_detection=HyperDetectionConfig(enable=True, start_round=3, cosine_search=5),
+    )
+    state, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    assert "roc_auc" in hist[-1]

@@ -1,0 +1,108 @@
+"""Model zoo: shapes, registry contract, hypernetwork structure."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.models import make_hypernetwork
+from attackfl_tpu.models.layers import adaptive_avg_pool1d, adaptive_max_pool1d
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.registry import MODEL_REGISTRY, get_model
+
+ICU_MODELS = ["CNNModel", "RNNModel", "TransformerModel"]
+
+
+@pytest.fixture(scope="module")
+def icu_inputs():
+    return jnp.ones((3, 7)), jnp.ones((3, 16))
+
+
+@pytest.mark.parametrize("name", ICU_MODELS)
+def test_icu_models_shapes_and_range(name, icu_inputs, rng):
+    model = get_model(name)
+    v, l = icu_inputs
+    params = model.init(rng, v, l)
+    out = model.apply(params, v, l)
+    assert out.shape == (3, 1)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) <= 1))  # sigmoid
+
+
+@pytest.mark.parametrize("name", ICU_MODELS)
+def test_icu_models_dropout_only_in_train(name, icu_inputs, rng):
+    model = get_model(name)
+    v, l = icu_inputs
+    params = model.init(rng, v, l)
+    a = model.apply(params, v, l)
+    b = model.apply(params, v, l)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # eval deterministic
+    k = jax.random.PRNGKey(1)
+    c = model.apply(params, v, l, train=True, rngs={"dropout": k})
+    d = model.apply(params, v, l, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.array_equal(np.asarray(c), np.asarray(d))  # dropout active
+
+
+def test_registry_contract():
+    for name in ["CNNModel", "RNNModel", "TransformerModel", "TransformerClassifier", "ResNet18"]:
+        assert name in MODEL_REGISTRY
+    with pytest.raises(ValueError):
+        get_model("Bogus")
+
+
+def test_har_classifier_shapes(rng):
+    model = get_model("TransformerClassifier")
+    x = jnp.ones((2, 561))
+    params = model.init(rng, x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 6)
+    # torch channel-first layout also accepted
+    out2 = model.apply(params, jnp.ones((2, 1, 561)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+def test_rnn_masks_sentinel_values(rng):
+    """RNNModel zeroes inputs equal to -2.0 (reference: src/Model.py:98,122)."""
+    model = get_model("RNNModel")
+    v = jnp.zeros((2, 7))
+    l = jnp.zeros((2, 16))
+    params = model.init(rng, v, l)
+    masked = model.apply(params, jnp.full((2, 7), -2.0), l)
+    zeros = model.apply(params, jnp.zeros((2, 7)), l)
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(zeros), atol=1e-6)
+
+
+def test_adaptive_pools_match_torch_semantics():
+    # torch AdaptiveAvgPool1d(4) over length 7: bins [0:2],[1:4],[3:6],[5:7]
+    x = jnp.arange(7, dtype=jnp.float32)[None, :, None]
+    out = np.asarray(adaptive_avg_pool1d(x, 4))[0, :, 0]
+    expected = [np.mean([0, 1]), np.mean([1, 2, 3]), np.mean([3, 4, 5]), np.mean([5, 6])]
+    np.testing.assert_allclose(out, expected)
+    mx = np.asarray(adaptive_max_pool1d(x, 4))[0, :, 0]
+    np.testing.assert_allclose(mx, [1, 3, 5, 6])
+
+
+def test_hypernetwork_generates_target_structure(rng):
+    model = get_model("TransformerModel")
+    template = model.init(rng, jnp.ones((1, 7)), jnp.ones((1, 16)))["params"]
+    hnet, apply_fn = make_hypernetwork(template, n_nodes=4)
+    hparams = hnet.init(rng, jnp.asarray(0))["params"]
+    params, emb = apply_fn(hparams, jnp.asarray(2))
+    assert emb.shape == (8,)
+    assert jax.tree.structure(params) == jax.tree.structure(template)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(template)):
+        assert a.shape == b.shape
+    # different clients generate different weights
+    p0, e0 = apply_fn(hparams, jnp.asarray(0))
+    assert not np.allclose(np.asarray(e0), np.asarray(emb))
+    assert pt.ref_distance(p0, params) > 1e-6
+    # generated params run through the target model
+    out = model.apply({"params": params}, jnp.ones((2, 7)), jnp.ones((2, 16)))
+    assert out.shape == (2, 1)
+
+
+def test_hypernetwork_spec_norm_unimplemented(rng):
+    model = get_model("CNNModel")
+    template = model.init(rng, jnp.ones((1, 7)), jnp.ones((1, 16)))["params"]
+    hnet, apply_fn = make_hypernetwork(template, 2, spec_norm=True)
+    with pytest.raises(NotImplementedError):
+        hnet.init(rng, jnp.asarray(0))

@@ -1,0 +1,136 @@
+"""Pytree utilities: the state_dict-arithmetic substrate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.ops import pytree as pt
+
+
+def make_tree(seed=0, n=None):
+    r = np.random.default_rng(seed)
+    shape = lambda *s: ((n,) + s) if n else s
+    return {
+        "dense": {"kernel": jnp.asarray(r.normal(size=shape(4, 3)).astype(np.float32)),
+                  "bias": jnp.asarray(r.normal(size=shape(3)).astype(np.float32))},
+        "conv": jnp.asarray(r.normal(size=shape(2, 3, 5)).astype(np.float32)),
+    }
+
+
+def test_stack_unstack_roundtrip():
+    trees = [make_tree(i) for i in range(4)]
+    stacked = pt.tree_stack(trees)
+    assert jax.tree.leaves(stacked)[0].shape[0] == 4
+    back = pt.tree_unstack(stacked)
+    for a, b in zip(trees, back):
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_tree_take_and_select():
+    stacked = pt.tree_stack([make_tree(i) for i in range(5)])
+    taken = pt.tree_take(stacked, jnp.asarray([3, 1]))
+    np.testing.assert_array_equal(
+        np.asarray(taken["conv"][0]), np.asarray(stacked["conv"][3])
+    )
+    mask = jnp.asarray([True, False, True, False, False])
+    other = jax.tree.map(jnp.zeros_like, stacked)
+    sel = pt.tree_select(mask, stacked, other)
+    assert np.allclose(np.asarray(sel["conv"][1]), 0)
+    np.testing.assert_array_equal(np.asarray(sel["conv"][2]), np.asarray(stacked["conv"][2]))
+
+
+def test_ravel_unravel_roundtrip():
+    tree = make_tree(7)
+    flat = pt.tree_ravel(tree)
+    assert flat.shape == (pt.tree_size(tree),)
+    back = pt.tree_unravel_like(flat, tree)
+    for x, y in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+def test_ravel_stacked_order_consistent():
+    trees = [make_tree(i) for i in range(3)]
+    stacked = pt.tree_stack(trees)
+    mat = pt.tree_ravel_stacked(stacked)
+    for i, t in enumerate(trees):
+        np.testing.assert_allclose(np.asarray(mat[i]), np.asarray(pt.tree_ravel(t)))
+
+
+def test_ref_distance_is_sum_of_per_leaf_norms():
+    """The reference's compute_distance (src/Utils.py:30-49) sums per-tensor
+    L2 norms — NOT a global norm."""
+    a, b = make_tree(0), make_tree(1)
+    expected = sum(
+        np.linalg.norm((np.asarray(x) - np.asarray(y)).ravel())
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+    np.testing.assert_allclose(float(pt.ref_distance(a, b)), expected, rtol=1e-5)
+    # and differs from the global L2 norm
+    global_norm = float(pt.tree_l2_norm(jax.tree.map(lambda x, y: x - y, a, b)))
+    assert abs(expected - global_norm) > 1e-3
+
+
+def test_pairwise_matches_naive():
+    stacked = pt.tree_stack([make_tree(i) for i in range(4)])
+    mat = np.asarray(pt.pairwise_ref_distance(stacked))
+    trees = pt.tree_unstack(stacked)
+    for i in range(4):
+        for j in range(4):
+            # Gram-identity path trades a little f32 precision for O(N*P)
+            # memory; tolerance reflects that
+            np.testing.assert_allclose(
+                mat[i, j], float(pt.ref_distance(trees[i], trees[j])),
+                rtol=2e-3, atol=2e-3,
+            )
+
+
+def test_distance_to_each():
+    stacked = pt.tree_stack([make_tree(i) for i in range(4)])
+    cand = make_tree(9)
+    d = np.asarray(pt.distance_to_each(cand, stacked))
+    trees = pt.tree_unstack(stacked)
+    for i in range(4):
+        np.testing.assert_allclose(d[i], float(pt.ref_distance(cand, trees[i])), rtol=1e-5)
+
+
+def test_spectral_norm_option():
+    """matrix_spectral=True reproduces torch.linalg.norm(2D, ord=2)."""
+    a = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32))}
+    b = {"w": jnp.zeros((4, 3), jnp.float32)}
+    spect = float(pt.ref_distance(a, b, matrix_spectral=True))
+    expected = np.linalg.svd(np.asarray(a["w"]), compute_uv=False)[0]
+    np.testing.assert_allclose(spect, expected, rtol=1e-5)
+
+
+def test_mean_std_bessel():
+    stacked = pt.tree_stack([make_tree(i) for i in range(5)])
+    std = pt.tree_std(stacked, ddof=1)
+    np.testing.assert_allclose(
+        np.asarray(std["conv"]),
+        np.std(np.asarray(stacked["conv"]), axis=0, ddof=1),
+        rtol=1e-5,
+    )
+    # single-model std defined as zero (torch would give NaN)
+    one = pt.tree_stack([make_tree(0)])
+    assert not np.any(np.isnan(np.asarray(pt.tree_std(one)["conv"])))
+    assert np.all(np.asarray(pt.tree_std(one)["conv"]) == 0)
+
+
+def test_weighted_mean():
+    stacked = pt.tree_stack([make_tree(i) for i in range(3)])
+    w = jnp.asarray([1.0, 2.0, 3.0])
+    got = np.asarray(pt.tree_weighted_mean(stacked, w)["conv"])
+    arr = np.asarray(stacked["conv"])
+    expected = (arr * np.array([1, 2, 3])[:, None, None, None]).sum(0) / 6
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_cosine_and_broadcast():
+    a = make_tree(0)
+    assert float(pt.tree_cosine(a, a)) == pytest.approx(1.0, abs=1e-5)
+    neg = jax.tree.map(lambda x: -x, a)
+    assert float(pt.tree_cosine(a, neg)) == pytest.approx(-1.0, abs=1e-5)
+    bc = pt.tree_broadcast(a, 6)
+    assert jax.tree.leaves(bc)[0].shape[0] == 6

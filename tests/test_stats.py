@@ -1,0 +1,80 @@
+"""Self-implemented statistics vs sklearn/scipy oracles (available in the
+test image; the framework itself does not depend on them)."""
+
+import numpy as np
+import pytest
+
+from attackfl_tpu.ops.stats import (
+    GaussianMixture,
+    dbscan_labels,
+    mahalanobis,
+    median_abs_deviation,
+    pca_fit_transform,
+)
+
+sklearn = pytest.importorskip("sklearn")
+
+
+def test_pca_matches_sklearn(np_rng):
+    from sklearn.decomposition import PCA
+
+    x = np_rng.normal(size=(30, 8))
+    ours = pca_fit_transform(x, 3)
+    theirs = PCA(3).fit_transform(x)
+    # components are sign-ambiguous
+    np.testing.assert_allclose(np.abs(ours), np.abs(theirs), atol=1e-8)
+
+
+def test_pca_degenerate_rank():
+    x = np.ones((5, 4))
+    out = pca_fit_transform(x, 3)
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out, 0.0, atol=1e-8)
+
+
+def test_mad_matches_scipy(np_rng):
+    from scipy.stats import median_abs_deviation as MAD
+
+    x = np_rng.normal(size=200)
+    assert median_abs_deviation(x) == pytest.approx(MAD(x), abs=1e-12)
+
+
+def test_dbscan_matches_sklearn(np_rng):
+    from sklearn.cluster import DBSCAN
+
+    # two clusters plus outliers
+    x = np.concatenate([
+        np_rng.normal(0, 0.3, size=(20, 3)),
+        np_rng.normal(10, 0.3, size=(20, 3)),
+        np.array([[100.0, 100, 100], [-50, 0, 50]]),
+    ])
+    mine = dbscan_labels(x, eps=1.5, min_samples=4)
+    theirs = DBSCAN(eps=1.5, min_samples=4).fit(x).labels_
+    # same noise set and same partition structure
+    np.testing.assert_array_equal(mine == -1, theirs == -1)
+    for lbl in set(mine) - {-1}:
+        members = mine == lbl
+        assert len(set(theirs[members])) == 1
+
+
+def test_gmm_separates_two_blobs(np_rng):
+    x = np.concatenate([
+        np_rng.normal(0, 1, size=(50, 4)),
+        np_rng.normal(20, 1, size=(50, 4)),
+    ])
+    gmm = GaussianMixture(2, seed=1).fit(x)
+    probs = gmm.predict_proba(x)
+    hard = probs.argmax(1)
+    # each blob maps to one component
+    assert len(set(hard[:50])) == 1 and len(set(hard[50:])) == 1
+    assert hard[0] != hard[60]
+    # means close to blob centers (order-free)
+    centers = sorted(float(m.mean()) for m in gmm.means_)
+    assert centers[0] == pytest.approx(0.0, abs=0.5)
+    assert centers[1] == pytest.approx(20.0, abs=0.5)
+
+
+def test_mahalanobis_identity_cov(np_rng):
+    x = np.array([3.0, 4.0])
+    d = mahalanobis(x, np.zeros(2), np.eye(2))
+    assert d == pytest.approx(5.0, abs=1e-9)
