@@ -38,7 +38,13 @@ def client_main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="attackfl_tpu client launcher")
     parser.add_argument("--config", type=str, default="config.yaml")
     parser.add_argument("--device", type=str, required=False, help="accepted for parity; unused")
-    parser.add_argument("--attack", type=bool, required=False, default=False)
+    # accepts bare `--attack` and the reference's `--attack True` form
+    # (client.py:21 uses argparse type=bool, which would treat ANY string,
+    # even "False", as truthy — parse the text instead)
+    parser.add_argument(
+        "--attack", nargs="?", const=True, default=False,
+        type=lambda s: str(s).strip().lower() in ("true", "1", "yes"),
+    )
     parser.add_argument("--attack_mode", type=str,
                         choices=["Random", "Min-Max", "Min-Sum", "Opt-Fang", "LIE"])
     parser.add_argument("--attack_round", type=int)

@@ -142,13 +142,19 @@ class Validation:
         self.data_name = data_name
         self.logger = logger
         self.test_data = {k: jnp.asarray(v) for k, v in test_data.items()}
-        self._eval = jax.jit(partial(_EVALUATORS[data_name], model, test_data=self.test_data))
+        # raw (unjitted) evaluators are exposed so the fused round-scan can
+        # inline validation into its own XLA program
+        self.eval_fn = partial(_EVALUATORS[data_name], model, test_data=self.test_data)
+        self._eval = jax.jit(self.eval_fn)
         if data_name in _HYPER_EVALUATORS:
-            self._eval_hyper = jax.jit(
-                partial(_HYPER_EVALUATORS[data_name], model, test_data=self.test_data)
+            self.eval_hyper_fn = partial(
+                _HYPER_EVALUATORS[data_name], model, test_data=self.test_data
             )
+            self._eval_hyper = jax.jit(self.eval_hyper_fn)
         else:
-            self._eval_hyper = None  # HAR has no hyper eval (reference: Validation.py:138-145)
+            # HAR has no hyper eval (reference: Validation.py:138-145)
+            self.eval_hyper_fn = None
+            self._eval_hyper = None
 
     def test(self, params: Any) -> tuple[bool, dict[str, float]]:
         out = {k: np.asarray(v) for k, v in self._eval(params).items()}

@@ -120,11 +120,14 @@ class Simulator:
                 self.genuine_idx, self.hnet_apply, self.client_pools, constrain,
             )
             self.round_step = jax.jit(round_step)
+            self._round_step_raw = round_step
             self.generate_all = jax.jit(generate_all)
+            self._generate_all_raw = generate_all
             hyper_update, self.hyper_tx = build_hyper_update(
                 cfg, self.hnet_apply, cfg.total_clients
             )
             self.hyper_update = jax.jit(hyper_update)
+            self._hyper_update_raw = hyper_update
             self.detector = None
             if cfg.hyper_detection.enable:
                 hd = cfg.hyper_detection
@@ -139,9 +142,13 @@ class Simulator:
                 self.genuine_idx, self.client_pools, constrain,
             )
             self.round_step = jax.jit(round_step)
-            self.aggregate = jax.jit(build_aggregator(self.model, cfg, test_np))
+            self._round_step_raw = round_step
+            aggregate = build_aggregator(self.model, cfg, test_np)
+            self.aggregate = jax.jit(aggregate)
+            self._aggregate_raw = aggregate
 
         self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
+        self._fused_cache: dict[int, Callable] = {}
 
     # ------------------------------------------------------------------
     # state
@@ -344,6 +351,228 @@ class Simulator:
             new_state["hyper_opt_state"] = opt_state
             new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
         return new_state, metrics
+
+    # ------------------------------------------------------------------
+    # fused multi-round fast path
+    # ------------------------------------------------------------------
+
+    def supports_fused(self) -> bool:
+        """True when the whole round (train → attack → aggregate → validate)
+        is expressible as one XLA program, i.e. no host-side per-round work.
+
+        GMM / FLTracer filter with sklearn between training and aggregation,
+        and hyper-detection runs DBSCAN + rollback on host — those modes
+        stay on the per-round path.
+        """
+        if self.cfg.mode in ("gmm", "fltracer"):
+            return False
+        if self.is_hyper and self.detector is not None:
+            return False
+        return True
+
+    def _build_fused_body(self) -> Callable:
+        """One broadcast as a ``lax.scan`` body over the simulation state.
+
+        Collapses the reference's whole distributed round protocol — START
+        broadcast, N client trainings, UPDATE barrier, aggregation,
+        validation gate, accept-or-retry (server.py:205-567) — into a single
+        scan step: a failed round (NaN training or failed validation) keeps
+        the old params via ``where`` instead of a host-side retry branch.
+        """
+        cfg = self.cfg
+        eval_fn = None
+        if self.validation is not None:
+            eval_fn = (self.validation.eval_hyper_fn if self.is_hyper
+                       else self.validation.eval_fn)
+
+        def accept(flag, new, old):
+            return jax.tree.map(lambda n, o: jnp.where(flag, n, o), new, old)
+
+        if self.is_hyper:
+            round_step = self._round_step_raw
+            hyper_update = self._hyper_update_raw
+            generate_all = self._generate_all_raw
+
+            def body(state, _):
+                # split(3) matches run_round's pattern so both paths walk
+                # the same rng trajectory (k_agg is unused in hyper mode)
+                rng, k_round, _k_agg = jax.random.split(state["rng"], 3)
+                b = state["broadcasts"] + 1
+                active_mask = jnp.asarray(state["active_mask"])
+                stacked, sizes, new_gen, train_ok, loss = round_step(
+                    state["hnet_params"], state["prev_genuine"],
+                    state["have_genuine"], active_mask, k_round, b,
+                )
+                new_hp, new_opt = hyper_update(
+                    state["hnet_params"], state["hyper_opt_state"],
+                    stacked, active_mask,
+                )
+                ok = train_ok
+                metrics = {"train_loss": loss}
+                if eval_fn is not None:
+                    gen_params, _ = generate_all(new_hp)
+                    ev = eval_fn(stacked_params=gen_params)
+                    ok = ok & ev.pop("ok")
+                    metrics.update(ev)
+                new_state = {
+                    "hnet_params": accept(ok, new_hp, state["hnet_params"]),
+                    "hyper_opt_state": accept(ok, new_opt, state["hyper_opt_state"]),
+                    "prev_genuine": accept(train_ok, new_gen, state["prev_genuine"]),
+                    "have_genuine": state["have_genuine"] | train_ok,
+                    "active_mask": active_mask,
+                    "rng": rng,
+                    "completed_rounds": state["completed_rounds"] + ok.astype(jnp.int32),
+                    "broadcasts": b,
+                }
+                metrics["ok"] = ok
+                return new_state, metrics
+
+        else:
+            round_step = self._round_step_raw
+            aggregate = self._aggregate_raw
+            wmask = jnp.ones((cfg.total_clients,), jnp.float32)
+
+            def body(state, _):
+                rng, k_round, k_agg = jax.random.split(state["rng"], 3)
+                b = state["broadcasts"] + 1
+                stacked, sizes, new_gen, train_ok, loss = round_step(
+                    state["global_params"], state["prev_genuine"],
+                    state["have_genuine"], k_round, b,
+                )
+                new_global = aggregate(
+                    state["global_params"], stacked, sizes, wmask, k_agg
+                )
+                ok = train_ok
+                metrics = {"train_loss": loss}
+                if eval_fn is not None:
+                    ev = eval_fn(params=new_global)
+                    ok = ok & ev.pop("ok")
+                    metrics.update(ev)
+                new_state = {
+                    "global_params": accept(ok, new_global, state["global_params"]),
+                    "prev_genuine": accept(train_ok, new_gen, state["prev_genuine"]),
+                    "have_genuine": state["have_genuine"] | train_ok,
+                    "rng": rng,
+                    "completed_rounds": state["completed_rounds"] + ok.astype(jnp.int32),
+                    "broadcasts": b,
+                }
+                metrics["ok"] = ok
+                return new_state, metrics
+
+        return body
+
+    def _fused_chunk(self, length: int) -> Callable:
+        fn = self._fused_cache.get(length)
+        if fn is None:
+            body = self._build_fused_body()
+
+            def chunk(state):
+                return jax.lax.scan(body, state, None, length=length)
+
+            fn = jax.jit(chunk, donate_argnums=0)
+            self._fused_cache[length] = fn
+        return fn
+
+    def _canonical_device_state(self, state: dict[str, Any]) -> dict[str, Any]:
+        """Cast host-typed counters/flags so the fused carry has stable
+        dtypes across scan iterations."""
+        out = dict(state)
+        out["completed_rounds"] = jnp.asarray(state["completed_rounds"], jnp.int32)
+        out["broadcasts"] = jnp.asarray(state["broadcasts"], jnp.int32)
+        out["have_genuine"] = jnp.asarray(bool(state["have_genuine"]))
+        if "active_mask" in out:
+            out["active_mask"] = jnp.asarray(state["active_mask"], jnp.float32)
+        return out
+
+    def run_scan(
+        self, state: dict[str, Any], num_broadcasts: int
+    ) -> tuple[dict[str, Any], dict[str, Any]]:
+        """Run ``num_broadcasts`` rounds as ONE jitted ``lax.scan`` dispatch.
+
+        Returns (new_state, metrics) where each metrics value is a
+        ``(num_broadcasts,)`` array.  Failed rounds keep the previous
+        params (the retry clock still advances, matching run_round).  The
+        input state is donated — do not reuse it after this call.
+        """
+        if not self.supports_fused():
+            raise ValueError(
+                f"mode '{self.cfg.mode}' (hyper-detection={self.is_hyper and self.detector is not None}) "
+                "needs host-side per-round work; use run_round/run instead"
+            )
+        if "active_mask" in state and not np.all(np.asarray(state["active_mask"]) > 0):
+            # the fused hyper body validates ALL clients' personalized
+            # outputs; with removed clients (a state resumed from a
+            # hyper-detection run) that would pool rolled-back clients into
+            # the AUC — the per-round path filters them (tree_take over
+            # active ids, _run_hyper_round)
+            raise ValueError(
+                "state has inactive clients (resumed from a hyper-detection "
+                "run?); use run_round/run for active-mask-aware validation"
+            )
+        fn = self._fused_chunk(num_broadcasts)
+        return fn(self._canonical_device_state(state))
+
+    def run_fast(
+        self,
+        num_rounds: int | None = None,
+        state: dict[str, Any] | None = None,
+        chunk_size: int | None = None,
+        save_checkpoints: bool = True,
+        verbose: bool = True,
+    ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+        """Like :meth:`run` but on the fused scan path: one device dispatch
+        per chunk instead of several per round.  Checkpoints land per chunk
+        rather than per round (the reference checkpoints per round,
+        server.py:549-553 — set ``chunk_size=1`` for that cadence)."""
+        cfg = self.cfg
+        num_rounds = num_rounds if num_rounds is not None else cfg.num_round
+        state = state if state is not None else self.load_or_init_state()
+        history: list[dict[str, Any]] = []
+        consecutive_failures = 0  # run()'s retry counter semantics
+        first_dispatch = True
+
+        while int(state["completed_rounds"]) < num_rounds:
+            remaining = num_rounds - int(state["completed_rounds"])
+            # Chunk sizing doubles as a compile-cache policy: retry tails use
+            # length-1 scans (one extra compile total) instead of compiling a
+            # fresh fused program for every shrinking remainder.
+            if chunk_size:
+                n = min(chunk_size, remaining)
+            elif first_dispatch:
+                n = remaining
+            else:
+                n = 1
+            first_dispatch = False
+            t0 = time.perf_counter()
+            state, metrics = self.run_scan(state, n)
+            elapsed = time.perf_counter() - t0
+            host = {k: np.asarray(v) for k, v in metrics.items()}
+            for i in range(n):
+                entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
+                         for k, v in host.items()}
+                entry["seconds"] = elapsed / n
+                history.append(entry)
+                if entry["ok"]:
+                    consecutive_failures = 0
+                else:
+                    consecutive_failures += 1
+            if consecutive_failures > MAX_ROUND_RETRIES:
+                raise RuntimeError(
+                    f"round failed {consecutive_failures} times in a row; "
+                    "aborting (the reference would retry forever, "
+                    "server.py:546-556)"
+                )
+            if save_checkpoints:
+                ckpt.save_state(ckpt.checkpoint_path(cfg), state)
+            if verbose:
+                done = int(state["completed_rounds"])
+                last = history[-1]
+                keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in last]
+                msg = " ".join(f"{k}={last[k]:.4f}" for k in keys)
+                print_with_color(
+                    f"[fast] {done}/{num_rounds} rounds, chunk of {n} in "
+                    f"{elapsed:.2f}s ({elapsed / n:.3f}s/round) {msg}", "green")
+        return state, history
 
     # ------------------------------------------------------------------
     # full run

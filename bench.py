@@ -22,6 +22,8 @@ from __future__ import annotations
 import json
 import time
 
+import jax
+
 NORTH_STAR_ROUNDS_PER_SEC = 100.0 / 60.0  # BASELINE.json north star
 
 
@@ -48,18 +50,21 @@ def main() -> None:
         log_path="/tmp/attackfl_bench",
     )
     sim = Simulator(cfg)
-    state = sim.init_state()
-
-    # warmup: compile + first round (excluded from timing)
-    state, metrics = sim.run_round(state)
-    assert metrics["ok"], f"warmup round failed: {metrics}"
-
     n_rounds = 4
+
+    # warmup: run the same n-round fused scan once (compiles it), excluded
+    # from timing
+    state = sim.init_state()
+    state, metrics = sim.run_scan(state, n_rounds)
+    jax.block_until_ready(metrics)
+    assert bool(metrics["ok"][-1]), f"warmup rounds failed: {metrics}"
+
     t0 = time.perf_counter()
-    for _ in range(n_rounds):
-        state, metrics = sim.run_round(state)
+    state, metrics = sim.run_scan(state, n_rounds)
+    jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - t0
     rounds_per_sec = n_rounds / elapsed
+    metrics = {k: v[-1] for k, v in metrics.items()}
 
     print(json.dumps({
         "metric": "fl_rounds_per_sec_100c",
