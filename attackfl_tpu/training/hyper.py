@@ -77,6 +77,7 @@ def build_hyper_round(
         model, cfg.data_name, train_data,
         epochs=cfg.epochs, batch_size=cfg.batch_size,
         lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
+        scan_unroll=cfg.scan_unroll,
     )
 
     constrain = constrain or (lambda tree: tree)

@@ -93,6 +93,7 @@ def build_local_update(
     batch_size: int,
     lr: float,
     clip_grad_norm: float,
+    scan_unroll: int = 1,
 ) -> Callable:
     """Build ``local_update(params, rng, idx, mask) -> (params, ok, loss)``.
 
@@ -131,7 +132,8 @@ def build_local_update(
                 return (params, opt_state, ok), loss
 
             (params, opt_state, ok), losses = jax.lax.scan(
-                batch_step, (params, opt_state, ok), (bidx, bmask, dropout_keys)
+                batch_step, (params, opt_state, ok), (bidx, bmask, dropout_keys),
+                unroll=scan_unroll,
             )
             return (params, opt_state, ok), jnp.mean(losses)
 

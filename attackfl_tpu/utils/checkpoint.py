@@ -15,12 +15,22 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
 
+def _is_key(x: Any) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _strip_keys(tree: Any) -> Any:
+    """Typed PRNG keys -> raw uint32 key data (msgpack-serializable)."""
+    return jax.tree.map(lambda x: jax.random.key_data(x) if _is_key(x) else x, tree)
+
+
 def save_state(path: str, state: Any) -> None:
-    state = jax.device_get(state)
+    state = jax.device_get(_strip_keys(state))
     data = serialization.to_bytes(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
@@ -31,7 +41,22 @@ def save_state(path: str, state: Any) -> None:
 def load_state(path: str, template: Any) -> Any:
     with open(path, "rb") as fh:
         data = fh.read()
-    return serialization.from_bytes(template, data)
+    loaded = serialization.from_bytes(_strip_keys(template), data)
+
+    # re-wrap raw key data with the template's prng impl
+    def _rewrap(t, l):
+        if not _is_key(t):
+            return l
+        try:
+            return jax.random.wrap_key_data(jnp.asarray(l), impl=jax.random.key_impl(t))
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"checkpoint {path!r} holds rng state from a different "
+                "prng_impl than the current config; rerun with the original "
+                "prng_impl or delete the checkpoint"
+            ) from e
+
+    return jax.tree.map(_rewrap, template, loaded)
 
 
 def checkpoint_path(cfg, base_dir: str | None = None) -> str:

@@ -47,6 +47,7 @@ def main() -> None:
         train_size=20000,
         test_size=4000,
         attacks=(AttackSpec(mode="LIE", num_clients=20, attack_round=2, args=(0.74,)),),
+        scan_unroll=4,
         log_path="/tmp/attackfl_bench",
     )
     sim = Simulator(cfg)
@@ -57,13 +58,14 @@ def main() -> None:
     state = sim.init_state()
     state, metrics = sim.run_scan(state, n_rounds)
     jax.block_until_ready(metrics)
-    assert bool(metrics["ok"][-1]), f"warmup rounds failed: {metrics}"
+    assert all(map(bool, metrics["ok"])), f"warmup rounds failed: {metrics}"
 
     t0 = time.perf_counter()
     state, metrics = sim.run_scan(state, n_rounds)
     jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - t0
     rounds_per_sec = n_rounds / elapsed
+    assert all(map(bool, metrics["ok"])), f"timed rounds failed: {metrics}"
     metrics = {k: v[-1] for k, v in metrics.items()}
 
     print(json.dumps({
