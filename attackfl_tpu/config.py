@@ -159,6 +159,8 @@ class Config:
                 f"Unknown prng_impl {self.prng_impl!r}; choose rbg, "
                 "unsafe_rbg or threefry2x32"
             )
+        if self.scan_unroll < 1:
+            raise ValueError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.mode not in AGGREGATION_MODES:
             raise ValueError(f"Unknown server mode {self.mode!r}; choose from {AGGREGATION_MODES}")
         if self.data_name not in DATA_NAMES:

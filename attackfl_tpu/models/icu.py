@@ -119,12 +119,16 @@ class TransformerModel(nn.Module):
     num_heads: int = 4
     ff_dim: int = 6
     dropout_rate: float = 0.3
+    # exact seq-len-1 attention shortcut (see layers.Seq1Attention): same
+    # math, same param tree, ~half the attention kernels per step
+    seq1_fast: bool = True
 
     def _branch(self, x: jnp.ndarray, prefix: str, deterministic: bool) -> jnp.ndarray:
         x = nn.gelu(nn.Dense(64, name=f"{prefix}_dense")(x))
         x = x[:, None, :]  # seq len 1 (reference unsqueezes, Model.py:227)
         x = TransformerBlock(
-            64, self.num_heads, self.ff_dim, dropout_rate=0.1, name=f"{prefix}_transformer"
+            64, self.num_heads, self.ff_dim, dropout_rate=0.1,
+            seq1_fast=self.seq1_fast, name=f"{prefix}_transformer"
         )(x, deterministic=deterministic)
         x = x[:, 0, :]
         x = nn.LayerNorm(name=f"{prefix}_bn")(x)

@@ -8,6 +8,7 @@ resolved statically at trace time (no dynamic shapes under jit).
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,29 +39,125 @@ def adaptive_max_pool1d(x: jnp.ndarray, output_size: int) -> jnp.ndarray:
     return jnp.stack(outs, axis=1)
 
 
+class _InertProjection(nn.Module):
+    """Declares DenseGeneral-shaped kernel/bias params that take no part in
+    the computation (zero-gradient placeholders for tree parity)."""
+
+    kernel_shape: tuple[int, ...]
+    bias_shape: tuple[int, ...]
+
+    @nn.compact
+    def __call__(self) -> None:
+        def kernel_init(rng, shape, dtype=jnp.float32):
+            # flax DenseGeneral flattens grouped output dims before
+            # lecun_normal (fan computed on (in, H*dh)); match it so init
+            # VALUES agree with the full-MHA module, not just shapes
+            flat = (shape[0], int(np.prod(shape[1:])))
+            return nn.initializers.lecun_normal()(rng, flat, dtype).reshape(shape)
+
+        self.param("kernel", kernel_init, self.kernel_shape)
+        self.param("bias", nn.initializers.zeros, self.bias_shape)
+
+
+class Seq1Attention(nn.Module):
+    """Multi-head self-attention specialized (EXACTLY) to sequence length 1.
+
+    With one key, the softmax over attention logits is the constant 1
+    whatever q·k is, so (a) the attention output is just
+    ``out_proj(attn_dropout(1) * v_proj(x))`` and (b) the query/key
+    projections receive exactly zero gradient (d softmax / d logit = 0 for a
+    single logit) — true for the reference's torch MultiheadAttention over
+    its unsqueezed seq-1 ICU inputs too (src/Model.py:227,234).  Skipping
+    the q/k matmuls and the softmax is therefore an algebraic identity, not
+    an approximation; it roughly halves the attention op count per training
+    step.  Attention-weight dropout becomes one independent Bernoulli
+    scalar per (batch, head) — torch's elementwise dropout on the
+    (B,H,1,1) weight matrix, which is what the reference trains with.
+    (flax MHA's default broadcast_dropout=True instead shares ONE draw
+    across batch and heads at seq len 1, so under dropout this path matches
+    the torch reference's stochastic dynamics, not flax's.)
+
+    The parameter tree is IDENTICAL to flax's MultiHeadDotProductAttention
+    (query/key/value/out with (in, H, dh) kernels) so checkpoints,
+    hypernetwork heads and attack vectors are layout-compatible either way;
+    q/k params exist, stay at init, and receive zero gradient — exactly as
+    they (effectively) do in the reference.
+    """
+
+    num_heads: int
+    qkv_features: int
+    out_features: int
+    dropout_rate: float = 0.0
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, d = x.shape
+        assert s == 1, "Seq1Attention requires sequence length 1"
+        head_dim = self.qkv_features // self.num_heads
+        # q/k params declared for tree parity; mathematically inert (see
+        # class docstring), so their matmuls are never computed
+        _InertProjection((d, self.num_heads, head_dim),
+                         (self.num_heads, head_dim), name="query")()
+        _InertProjection((d, self.num_heads, head_dim),
+                         (self.num_heads, head_dim), name="key")()
+        value = nn.DenseGeneral(
+            features=(self.num_heads, head_dim), axis=-1, name="value"
+        )(x)  # (B, 1, H, dh)
+        if self.dropout_rate > 0.0 and not self.deterministic:
+            # attention-weight dropout over the (B, H, 1, 1) weight matrix
+            # degenerates to one Bernoulli scalar per (batch, head)
+            rng = self.make_rng("dropout")
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.dropout_rate, (b, 1, self.num_heads, 1)
+            )
+            value = value * keep / (1.0 - self.dropout_rate)
+        return nn.DenseGeneral(
+            features=self.out_features, axis=(-2, -1), name="out"
+        )(value)
+
+
 class TransformerBlock(nn.Module):
     """Pre-add/post-norm residual attention block.
 
     Mirrors the reference's TransformerBlock (src/Model.py:166-191):
     x = LN(x + Drop(MHA(x))); x = LN(x + Drop(FFN(x))), FFN = Dense(ff_dim)
     -> GELU -> Drop -> Dense(dim).
+
+    ``seq1_fast`` switches to the algebraically identical seq-len-1
+    attention (see Seq1Attention); forward values and gradients match flax
+    MHA exactly in deterministic mode.  Under attention dropout the fast
+    path follows the torch reference's per-(batch, head) masks rather than
+    flax's batch-broadcast default — different stochastic draws, same
+    architecture.
     """
 
     dim: int
     num_heads: int
     ff_dim: int
     dropout_rate: float = 0.1
+    seq1_fast: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads,
-            qkv_features=self.dim,
-            out_features=self.dim,
-            dropout_rate=self.dropout_rate,
-            deterministic=deterministic,
-            name="attention",
-        )(x, x)
+        if self.seq1_fast and x.shape[1] == 1:
+            attn = Seq1Attention(
+                num_heads=self.num_heads,
+                qkv_features=self.dim,
+                out_features=self.dim,
+                dropout_rate=self.dropout_rate,
+                deterministic=deterministic,
+                name="attention",
+            )(x)
+        else:
+            attn = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads,
+                qkv_features=self.dim,
+                out_features=self.dim,
+                dropout_rate=self.dropout_rate,
+                deterministic=deterministic,
+                name="attention",
+            )(x, x)
         x = nn.LayerNorm(name="attention_norm")(
             x + nn.Dropout(self.dropout_rate, deterministic=deterministic)(attn)
         )

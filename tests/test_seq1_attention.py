@@ -1,0 +1,73 @@
+"""Seq1Attention is an algebraic identity, not an approximation.
+
+At sequence length 1 the attention softmax is the constant 1, so the
+attention output reduces to out_proj(v_proj(x)) and q/k projections get
+exactly zero gradient — including under flax's full MHA (and torch's, which
+is why the reference's q/k weights never move either, src/Model.py:227,234).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.models.icu import TransformerModel
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    v = jax.random.normal(jax.random.PRNGKey(1), (32, 7))
+    l = jax.random.normal(jax.random.PRNGKey(2), (32, 16))
+    return v, l
+
+
+@pytest.fixture(scope="module")
+def params(inputs):
+    v, l = inputs
+    return TransformerModel(seq1_fast=False).init(jax.random.PRNGKey(0), v, l)["params"]
+
+
+def test_param_tree_identical(inputs):
+    v, l = inputs
+    pf = TransformerModel(seq1_fast=True).init(jax.random.PRNGKey(0), v, l)["params"]
+    ps = TransformerModel(seq1_fast=False).init(jax.random.PRNGKey(0), v, l)["params"]
+    assert jax.tree.structure(pf) == jax.tree.structure(ps)
+    assert all(a.shape == b.shape for a, b in zip(jax.tree.leaves(pf), jax.tree.leaves(ps)))
+
+
+def test_forward_exact(params, inputs):
+    v, l = inputs
+    fast = TransformerModel(seq1_fast=True).apply({"params": params}, v, l)
+    slow = TransformerModel(seq1_fast=False).apply({"params": params}, v, l)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(slow), atol=1e-6)
+
+
+def test_gradients_exact_and_qk_zero(params, inputs):
+    v, l = inputs
+
+    def loss(p, mod):
+        return mod.apply({"params": p}, v, l).sum()
+
+    g_slow = jax.grad(loss)(params, TransformerModel(seq1_fast=False))
+    g_fast = jax.grad(loss)(params, TransformerModel(seq1_fast=True))
+    for branch in ("vitals_transformer", "labs_transformer"):
+        for qk in ("query", "key"):
+            # zero even for full MHA: d softmax(single logit) = 0
+            assert float(jnp.abs(g_slow[branch]["attention"][qk]["kernel"]).max()) == 0.0
+            assert float(jnp.abs(g_fast[branch]["attention"][qk]["kernel"]).max()) == 0.0
+    flat_s = jnp.concatenate([x.ravel() for x in jax.tree.leaves(g_slow)])
+    flat_f = jnp.concatenate([x.ravel() for x in jax.tree.leaves(g_fast)])
+    np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_s), atol=1e-6)
+
+
+def test_train_mode_dropout_runs(params, inputs):
+    """Train-mode forward with attention dropout produces finite outputs
+    and differs across dropout rngs (the masks are live)."""
+    v, l = inputs
+    mod = TransformerModel(seq1_fast=True)
+    o1 = mod.apply({"params": params}, v, l, train=True,
+                   rngs={"dropout": jax.random.PRNGKey(3)})
+    o2 = mod.apply({"params": params}, v, l, train=True,
+                   rngs={"dropout": jax.random.PRNGKey(4)})
+    assert np.all(np.isfinite(np.asarray(o1)))
+    assert float(jnp.abs(o1 - o2).max()) > 0.0
