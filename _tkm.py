@@ -1,0 +1,72 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax; jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+import jax.numpy as jnp, numpy as np, optax
+from attackfl_tpu.models.icu import TransformerModel
+from attackfl_tpu.ops import fused_step as fs
+
+model = TransformerModel(seq1_fast=True)
+rng = jax.random.PRNGKey(0)
+C, B, N = 8, 16, 64
+vit = jax.random.normal(jax.random.PRNGKey(1), (N, 7))
+labs = jax.random.normal(jax.random.PRNGKey(2), (N, 16))
+lab = (jax.random.uniform(jax.random.PRNGKey(3), (N,)) > 0.5).astype(jnp.float32)
+dataset = {"vitals": vit, "labs": labs, "label": lab}
+
+params = model.init(rng, vit[:1], labs[:1])["params"]
+stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (C,)+x.shape), params)
+
+# pack/unpack roundtrip
+gp = fs.pack_params(stacked)
+rt = fs.unpack_params(gp, stacked)
+for (pa, a), (pb, b) in zip(jax.tree_util.tree_leaves_with_path(stacked), jax.tree_util.tree_leaves_with_path(rt)):
+    assert np.allclose(a, b), pa
+print("pack/unpack roundtrip OK")
+
+# one epoch, dropout off, vs JAX reference (same perm schedule)
+keys = jax.random.split(jax.random.PRNGKey(9), C)
+idx = jnp.stack([jax.random.permutation(jax.random.PRNGKey(100+i), N)[:48] for i in range(C)])
+mask = jnp.ones((C, 48), bool)
+EPOCHS = 2
+upd = fs.build_fused_local_update(dataset, epochs=EPOCHS, batch_size=B, lr=0.004,
+                                  clip_grad_norm=1.0, dropout=(0,0,0), g_clients=8, interpret=True)
+new_p, ok, loss = upd(params, keys, idx, mask)
+print("kernel ok:", np.asarray(ok).all(), "loss:", np.asarray(loss)[:3])
+
+# mirror JAX implementation (no dropout, same perm/Adam/clip)
+def loss_fn(p, bvit, blabs, by, bm):
+    probs = model.apply({"params": p}, bvit, blabs)[:, 0]
+    probs = jnp.clip(probs, 1e-7, 1-1e-7)
+    per = -(by*jnp.log(probs) + (1-by)*jnp.log(1-probs))
+    return jnp.sum(per*bm)/jnp.maximum(jnp.sum(bm), 1.0)
+tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(0.004))
+def one_client(p, key, cidx, cmask):
+    opt = tx.init(p)
+    eks = jax.random.split(key, EPOCHS)
+    hi = cidx.shape[0]; nb = -(-hi//B); pad = nb*B - hi
+    losses = []
+    for e in range(EPOCHS):
+        k_perm, _ = jax.random.split(eks[e])
+        perm = jax.random.permutation(k_perm, hi)
+        bidx = jnp.pad(cidx[perm], (0,pad)).reshape(nb,B)
+        bmask = jnp.pad(cmask[perm].astype(jnp.float32), (0,pad)).reshape(nb,B)
+        el = 0.0
+        for j in range(nb):
+            l, g = jax.value_and_grad(loss_fn)(p, vit[bidx[j]], labs[bidx[j]], lab[bidx[j]], bmask[j])
+            u, opt = tx.update(g, opt, p)
+            p = optax.apply_updates(p, u)
+            el += l
+        losses.append(el/nb)
+    return p, losses[-1]
+ref_p0, ref_loss0 = one_client(params, keys[0], idx[0], mask[0])
+
+kp0 = jax.tree.map(lambda x: x[0], new_p)
+flat_k = jnp.concatenate([x.ravel() for x in jax.tree.leaves(kp0)])
+flat_r = jnp.concatenate([x.ravel() for x in jax.tree.leaves(ref_p0)])
+diff = float(jnp.abs(flat_k - flat_r).max())
+print(f"client-0 param maxdiff vs jax.grad reference: {diff:.2e}")
+print(f"loss kernel={float(loss[0]):.6f} ref={float(ref_loss0):.6f}")
+assert diff < 2e-4, diff
+assert abs(float(loss[0]) - float(ref_loss0)) < 1e-4
+print("KERNEL MATH MATCHES AUTODIFF")

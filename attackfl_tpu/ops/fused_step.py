@@ -1,0 +1,539 @@
+"""Pallas TPU mega-kernel: the whole local-training minibatch step as ONE
+kernel.
+
+The framework's hot loop is the reference's client SGD loop
+(client.py:80-107) vmapped over clients: per minibatch, forward + backward
++ grad-clip + Adam.  Under XLA that is ~150 small kernels per step, each
+~5-10us latency-bound — the step cost is kernel COUNT, not FLOPs
+(profiled: 585 steps x ~1.1ms at 100 clients on one chip).  This module
+hand-fuses the entire step for the flagship ICU TransformerModel into a
+single Pallas program: grid (client-chunks, minibatches), each step
+computing forward, hand-derived backward, global-norm clip and Adam for G
+clients' [B, 23] batches, with params/m/v blocks RESIDENT in VMEM across
+the minibatch grid axis (index map invariant along it) so HBM sees each
+chunk's state once per epoch.
+
+Exactness:
+* attention uses the seq-len-1 identity (models/layers.Seq1Attention):
+  softmax over one key is the constant 1; q/k receive exactly zero grad
+  and are not even passed in (Adam leaves zero-grad params untouched);
+* gelu = tanh approximation (flax default, same as the JAX path);
+* LayerNorm eps 1e-6 (flax), Adam b1 .9 / b2 .999 / eps 1e-8 with bias
+  correction, clip-by-global-norm across ALL leaves — matching optax
+  (`clip_by_global_norm` then `adam`, training/local.make_optimizer);
+* dropout masks come from the TPU hardware PRNG with torch-style
+  elementwise semantics (a different stream than the JAX path — same
+  distribution; parity is metric-level, SURVEY.md §7).
+
+With dropout rates forced to 0 the kernel is deterministic and is tested
+against jax.grad of the flax model (tests/test_pallas_step.py).
+Reference semantics being fused: client.train_ICU
+(/root/reference/client.py:74-112) with per-round Adam state and the
+clip-before-backward bug fixed (SURVEY.md §2 quirks).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+D = 64          # model width
+FF = 8          # ffn dim 6, padded to 8 (pad cols/rows stay zero)
+NV = 26         # [64]-vector slots in `vecs`
+B1, B2, EPS = 0.9, 0.999, 1e-8
+LN_EPS = 1e-6
+_GELU_C = math.sqrt(2.0 / math.pi)
+
+# vecs slot indices (per branch b in {0: vitals, 1: labs}: base = 11*b)
+S_BD, S_BV, S_BO, S_B1F, S_B2F, S_G1, S_BE1, S_G2, S_BE2, S_G3, S_BE3 = range(11)
+S_BF1, S_BF2, S_WOUT, S_BOUT = 22, 23, 24, 25
+
+BRANCHES = ("vitals", "labs")
+IN_DIMS = (7, 16)
+GROUP_ORDER = ("w_in", "w_sq", "w_ff1", "w_ff2", "w_h1", "w_h2", "vecs")
+N_G = len(GROUP_ORDER)
+
+
+# ---------------------------------------------------------------------------
+# packed parameter layout: 38 active leaves -> 7 dense groups
+# ---------------------------------------------------------------------------
+
+def pack_params(stacked: Any) -> dict[str, jnp.ndarray]:
+    """Stacked TransformerModel params [C, ...] -> packed dense groups."""
+    p = stacked
+    C = p["fc1"]["kernel"].shape[0]
+    f32 = jnp.float32
+
+    w_in = jnp.zeros((C, 2, 16, D), f32)
+    w_sq = jnp.zeros((C, 4, D, D), f32)
+    w_ff1 = jnp.zeros((C, 2, D, FF), f32)
+    w_ff2 = jnp.zeros((C, 2, FF, D), f32)
+    vecs = jnp.zeros((C, NV, D), f32)
+
+    for b, (name, f) in enumerate(zip(BRANCHES, IN_DIMS)):
+        blk = p[f"{name}_transformer"]
+        w_in = w_in.at[:, b, :f, :].set(p[f"{name}_dense"]["kernel"])
+        w_sq = w_sq.at[:, 2 * b].set(blk["attention"]["value"]["kernel"].reshape(C, D, D))
+        w_sq = w_sq.at[:, 2 * b + 1].set(blk["attention"]["out"]["kernel"].reshape(C, D, D))
+        w_ff1 = w_ff1.at[:, b, :, :6].set(blk["ffn_dense1"]["kernel"])
+        w_ff2 = w_ff2.at[:, b, :6, :].set(blk["ffn_dense2"]["kernel"])
+        base = 11 * b
+        vecs = vecs.at[:, base + S_BD].set(p[f"{name}_dense"]["bias"])
+        vecs = vecs.at[:, base + S_BV].set(blk["attention"]["value"]["bias"].reshape(C, D))
+        vecs = vecs.at[:, base + S_BO].set(blk["attention"]["out"]["bias"])
+        vecs = vecs.at[:, base + S_B1F, :6].set(blk["ffn_dense1"]["bias"])
+        vecs = vecs.at[:, base + S_B2F].set(blk["ffn_dense2"]["bias"])
+        vecs = vecs.at[:, base + S_G1].set(blk["attention_norm"]["scale"])
+        vecs = vecs.at[:, base + S_BE1].set(blk["attention_norm"]["bias"])
+        vecs = vecs.at[:, base + S_G2].set(blk["ffn_norm"]["scale"])
+        vecs = vecs.at[:, base + S_BE2].set(blk["ffn_norm"]["bias"])
+        vecs = vecs.at[:, base + S_G3].set(p[f"{name}_bn"]["scale"])
+        vecs = vecs.at[:, base + S_BE3].set(p[f"{name}_bn"]["bias"])
+
+    vecs = vecs.at[:, S_BF1].set(p["fc1"]["bias"])
+    vecs = vecs.at[:, S_BF2, :32].set(p["fc2"]["bias"])
+    vecs = vecs.at[:, S_WOUT, :32].set(p["output"]["kernel"][:, :, 0])
+    vecs = vecs.at[:, S_BOUT, :1].set(p["output"]["bias"])
+
+    return {"w_in": w_in, "w_sq": w_sq, "w_ff1": w_ff1, "w_ff2": w_ff2,
+            "w_h1": p["fc1"]["kernel"].astype(f32),
+            "w_h2": p["fc2"]["kernel"].astype(f32), "vecs": vecs}
+
+
+def unpack_params(groups: dict[str, jnp.ndarray], template: Any) -> Any:
+    """Packed groups -> stacked pytree shaped like ``template``.
+
+    Inert attention q/k leaves pass through from ``template`` unchanged —
+    exactly what their zero gradients would do under Adam.
+    """
+    C = groups["w_h1"].shape[0]
+    out = jax.tree.map(lambda x: x, template)  # fresh nested dicts
+    vecs = groups["vecs"]
+
+    for b, (name, f) in enumerate(zip(BRANCHES, IN_DIMS)):
+        base = 11 * b
+        blk = out[f"{name}_transformer"]
+        out[f"{name}_dense"]["kernel"] = groups["w_in"][:, b, :f, :]
+        out[f"{name}_dense"]["bias"] = vecs[:, base + S_BD]
+        blk["attention"]["value"]["kernel"] = groups["w_sq"][:, 2 * b].reshape(C, D, 4, 16)
+        blk["attention"]["value"]["bias"] = vecs[:, base + S_BV].reshape(C, 4, 16)
+        blk["attention"]["out"]["kernel"] = groups["w_sq"][:, 2 * b + 1].reshape(C, 4, 16, D)
+        blk["attention"]["out"]["bias"] = vecs[:, base + S_BO]
+        blk["ffn_dense1"]["kernel"] = groups["w_ff1"][:, b, :, :6]
+        blk["ffn_dense1"]["bias"] = vecs[:, base + S_B1F, :6]
+        blk["ffn_dense2"]["kernel"] = groups["w_ff2"][:, b, :6, :]
+        blk["ffn_dense2"]["bias"] = vecs[:, base + S_B2F]
+        blk["attention_norm"]["scale"] = vecs[:, base + S_G1]
+        blk["attention_norm"]["bias"] = vecs[:, base + S_BE1]
+        blk["ffn_norm"]["scale"] = vecs[:, base + S_G2]
+        blk["ffn_norm"]["bias"] = vecs[:, base + S_BE2]
+        out[f"{name}_bn"]["scale"] = vecs[:, base + S_G3]
+        out[f"{name}_bn"]["bias"] = vecs[:, base + S_BE3]
+
+    out["fc1"]["kernel"] = groups["w_h1"]
+    out["fc1"]["bias"] = vecs[:, S_BF1]
+    out["fc2"]["kernel"] = groups["w_h2"]
+    out["fc2"]["bias"] = vecs[:, S_BF2, :32]
+    out["output"]["kernel"] = vecs[:, S_WOUT, :32][..., None]
+    out["output"]["bias"] = vecs[:, S_BOUT, :1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kernel math helpers (plain jnp, traced inside the kernel)
+# ---------------------------------------------------------------------------
+
+def _gelu(x):
+    t = jnp.tanh(_GELU_C * (x + 0.044715 * x * x * x))
+    return 0.5 * x * (1.0 + t)
+
+
+def _gelu_grad(x):
+    t = jnp.tanh(_GELU_C * (x + 0.044715 * x * x * x))
+    return 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t * t) * _GELU_C * (1.0 + 0.134145 * x * x)
+
+
+def _ln_fwd(r, g, b):
+    mu = jnp.mean(r, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(r - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + LN_EPS)
+    xhat = (r - mu) * rstd
+    return xhat * g + b, xhat, rstd
+
+
+def _ln_bwd(dy, xhat, rstd, g):
+    dyg = dy * g
+    dg = jnp.sum(dy * xhat, axis=-2)
+    db = jnp.sum(dy, axis=-2)
+    dx = (dyg - jnp.mean(dyg, axis=-1, keepdims=True)
+          - xhat * jnp.mean(dyg * xhat, axis=-1, keepdims=True)) * rstd
+    return dx, dg, db
+
+
+def _bmm(x, w):
+    """[G,B,K] @ [G,K,N] -> [G,B,N]."""
+    return jax.lax.dot_general(x, w, (((2,), (1,)), ((0,), (0,))),
+                               preferred_element_type=jnp.float32)
+
+
+def _bmm_dw(x, dz):
+    """[G,B,K], [G,B,N] -> [G,K,N] (contract batch)."""
+    return jax.lax.dot_general(x, dz, (((1,), (1,)), ((0,), (0,))),
+                               preferred_element_type=jnp.float32)
+
+
+def _bmm_dx(dz, w):
+    """[G,B,N], [G,K,N] -> [G,B,K] (contract features)."""
+    return jax.lax.dot_general(dz, w, (((2,), (2,)), ((0,), (0,))),
+                               preferred_element_type=jnp.float32)
+
+
+def _mask(shape, rate):
+    """Torch-style elementwise inverted-dropout mask from the HW PRNG."""
+    bits = pltpu.prng_random_bits(shape)
+    thr = np.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return jnp.where(bits >= thr, np.float32(1.0 / (1.0 - rate)), np.float32(0.0))
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+def _train_step_kernel(sc_ref, *refs, lr, clip, drop_attn, drop_block,
+                       drop_head, g_clients, batch_b):
+    p_in, m_in, v_in = refs[:N_G], refs[N_G:2 * N_G], refs[2 * N_G:3 * N_G]
+    batch_ref = refs[3 * N_G]
+    loss_ref = refs[3 * N_G + 1]
+    p_out = refs[3 * N_G + 2:4 * N_G + 2]
+    m_out = refs[4 * N_G + 2:5 * N_G + 2]
+    v_out = refs[5 * N_G + 2:6 * N_G + 2]
+
+    i, j = pl.program_id(0), pl.program_id(1)
+    G, B = g_clients, batch_b
+    dropout = drop_attn > 0.0 or drop_block > 0.0 or drop_head > 0.0
+
+    # First minibatch of this client chunk: copy state into the resident
+    # output blocks (read AND written from here on; flushed at chunk end)
+    # and zero the loss accumulator.
+    @pl.when(j == 0)
+    def _():
+        for src, dst in zip(p_in + m_in + v_in, p_out + m_out + v_out):
+            dst[...] = src[...]
+        loss_ref[...] = jnp.zeros_like(loss_ref)
+
+    pd = {k: ref[...] for k, ref in zip(GROUP_ORDER, p_out)}
+    data = batch_ref[...].reshape(G, B, 32)
+    x0v, x0l = data[:, :, 0:7], data[:, :, 7:23]
+    y, msk = data[:, :, 23], data[:, :, 24]
+
+    if dropout:
+        pltpu.prng_seed(sc_ref[0] + (sc_ref[1] + j) * 7919 + i * 104729)
+
+    vecs = pd["vecs"]
+    ones = functools.partial(jnp.ones, dtype=jnp.float32)
+
+    # ---------------- forward ----------------
+    stash, xb = [], []
+    for b in range(2):
+        base = 11 * b
+        x0 = x0v if b == 0 else x0l
+        f = IN_DIMS[b]
+        z1 = _bmm(x0, pd["w_in"][:, b, :f, :]) + vecs[:, None, base + S_BD]
+        x1 = _gelu(z1)
+        v_ = _bmm(x1, pd["w_sq"][:, 2 * b]) + vecs[:, None, base + S_BV]
+        if drop_attn > 0.0:
+            mh = _mask((G, B, 4), drop_attn)   # one draw per (client,sample,head)
+            mw = jnp.broadcast_to(mh[..., None], (G, B, 4, 16)).reshape(G, B, D)
+        else:
+            mw = ones((G, B, D))
+        vd = v_ * mw
+        a = _bmm(vd, pd["w_sq"][:, 2 * b + 1]) + vecs[:, None, base + S_BO]
+        m1 = _mask((G, B, D), drop_block) if drop_block > 0.0 else ones((G, B, D))
+        r1 = x1 + a * m1
+        g1 = vecs[:, None, base + S_G1]
+        x2, xhat1, rstd1 = _ln_fwd(r1, g1, vecs[:, None, base + S_BE1])
+        z2 = _bmm(x2, pd["w_ff1"][:, b]) + vecs[:, None, base + S_B1F, :FF]
+        h = _gelu(z2)
+        mf = _mask((G, B, FF), drop_block) if drop_block > 0.0 else ones((G, B, FF))
+        hd = h * mf
+        yf = _bmm(hd, pd["w_ff2"][:, b]) + vecs[:, None, base + S_B2F]
+        m2 = _mask((G, B, D), drop_block) if drop_block > 0.0 else ones((G, B, D))
+        r2 = x2 + yf * m2
+        g2 = vecs[:, None, base + S_G2]
+        x3, xhat2, rstd2 = _ln_fwd(r2, g2, vecs[:, None, base + S_BE2])
+        g3 = vecs[:, None, base + S_G3]
+        xb_b, xhat3, rstd3 = _ln_fwd(x3, g3, vecs[:, None, base + S_BE3])
+        xb.append(xb_b)
+        stash.append((x0, z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
+                      hd, m2, xhat2, rstd2, g2, xhat3, rstd3, g3))
+
+    cc = jnp.concatenate(xb, axis=-1)                         # [G,B,128]
+    z4 = _bmm(cc, pd["w_h1"]) + vecs[:, None, S_BF1]
+    x4 = _gelu(z4)
+    m4 = _mask((G, B, D), drop_head) if drop_head > 0.0 else ones((G, B, D))
+    x4d = x4 * m4
+    z5 = _bmm(x4d, pd["w_h2"]) + vecs[:, None, S_BF2, :32]
+    x5 = _gelu(z5)                                            # [G,B,32]
+    w_out = vecs[:, S_WOUT, :32]
+    z6 = jnp.sum(x5 * w_out[:, None, :], axis=-1) + vecs[:, None, S_BOUT, 0]
+    prob = jax.nn.sigmoid(z6)                                 # [G,B]
+    lo, hi = np.float32(1e-7), np.float32(1.0 - 1e-7)
+    pc = jnp.clip(prob, lo, hi)
+
+    msum = jnp.maximum(jnp.sum(msk, axis=-1), 1.0)            # [G]
+    per = -(y * jnp.log(pc) + (1.0 - y) * jnp.log(1.0 - pc))
+    loss_step = jnp.sum(per * msk, axis=-1) / msum            # [G]
+    # accumulate into column 0 of the resident (G, 128) loss block — a
+    # dynamic-column store crashes the Mosaic compiler, so the per-step
+    # losses are summed (NaN propagates, preserving the tripwire) and the
+    # host divides by nb for the epoch mean
+    col0 = jax.lax.broadcasted_iota(jnp.int32, loss_ref.shape, 1) == 0
+    loss_ref[...] = loss_ref[...] + jnp.where(col0, loss_step[:, None], 0.0)
+
+    # ---------------- backward ----------------
+    within = ((prob > lo) & (prob < hi)).astype(jnp.float32)
+    dpc = msk * (pc - y) / (pc * (1.0 - pc)) / msum[:, None]
+    dz6 = dpc * within * prob * (1.0 - prob)                  # [G,B]
+    g_wout = jnp.sum(x5 * dz6[..., None], axis=1)             # [G,32]
+    g_bout = jnp.sum(dz6, axis=1)                             # [G]
+    dx5 = dz6[..., None] * w_out[:, None, :]
+    dz5 = dx5 * _gelu_grad(z5)
+    g_wh2 = _bmm_dw(x4d, dz5)
+    g_bf2 = jnp.sum(dz5, axis=1)
+    dx4 = _bmm_dx(dz5, pd["w_h2"]) * m4
+    dz4 = dx4 * _gelu_grad(z4)
+    g_wh1 = _bmm_dw(cc, dz4)
+    g_bf1 = jnp.sum(dz4, axis=1)
+    dcc = _bmm_dx(dz4, pd["w_h1"])
+
+    g_win = jnp.zeros((G, 2, 16, D), jnp.float32)
+    g_wsq = jnp.zeros((G, 4, D, D), jnp.float32)
+    g_wff1 = jnp.zeros((G, 2, D, FF), jnp.float32)
+    g_wff2 = jnp.zeros((G, 2, FF, D), jnp.float32)
+    g_vecs = jnp.zeros((G, NV, D), jnp.float32)
+
+    for b in range(2):
+        base = 11 * b
+        (x0, z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
+         hd, m2, xhat2, rstd2, g2, xhat3, rstd3, g3) = stash[b]
+        dxb = dcc[:, :, b * D:(b + 1) * D]
+        dx3, dg3, db3 = _ln_bwd(dxb, xhat3, rstd3, g3)
+        dr2, dg2, db2 = _ln_bwd(dx3, xhat2, rstd2, g2)
+        dyf = dr2 * m2
+        g_wff2 = g_wff2.at[:, b].set(_bmm_dw(hd, dyf))
+        db2f = jnp.sum(dyf, axis=1)
+        dz2 = _bmm_dx(dyf, pd["w_ff2"][:, b]) * mf * _gelu_grad(z2)
+        g_wff1 = g_wff1.at[:, b].set(_bmm_dw(x2, dz2))
+        db1f = jnp.sum(dz2, axis=1)                           # [G,FF]
+        dx2 = dr2 + _bmm_dx(dz2, pd["w_ff1"][:, b])
+        dr1, dg1, db1 = _ln_bwd(dx2, xhat1, rstd1, g1)
+        da = dr1 * m1
+        g_wsq = g_wsq.at[:, 2 * b + 1].set(_bmm_dw(vd, da))
+        dbo = jnp.sum(da, axis=1)
+        dv = _bmm_dx(da, pd["w_sq"][:, 2 * b + 1]) * mw
+        g_wsq = g_wsq.at[:, 2 * b].set(_bmm_dw(x1, dv))
+        dbv = jnp.sum(dv, axis=1)
+        dx1 = dr1 + _bmm_dx(dv, pd["w_sq"][:, 2 * b])
+        dz1 = dx1 * _gelu_grad(z1)
+        f = IN_DIMS[b]
+        g_win = g_win.at[:, b, :f, :].set(_bmm_dw(x0, dz1))
+        g_vecs = g_vecs.at[:, base + S_BD].set(jnp.sum(dz1, axis=1))
+        g_vecs = g_vecs.at[:, base + S_BV].set(dbv)
+        g_vecs = g_vecs.at[:, base + S_BO].set(dbo)
+        g_vecs = g_vecs.at[:, base + S_B1F, :FF].set(db1f)
+        g_vecs = g_vecs.at[:, base + S_B2F].set(db2f)
+        g_vecs = g_vecs.at[:, base + S_G1].set(dg1)
+        g_vecs = g_vecs.at[:, base + S_BE1].set(db1)
+        g_vecs = g_vecs.at[:, base + S_G2].set(dg2)
+        g_vecs = g_vecs.at[:, base + S_BE2].set(db2)
+        g_vecs = g_vecs.at[:, base + S_G3].set(dg3)
+        g_vecs = g_vecs.at[:, base + S_BE3].set(db3)
+
+    g_vecs = g_vecs.at[:, S_BF1].set(g_bf1)
+    g_vecs = g_vecs.at[:, S_BF2, :32].set(g_bf2)
+    g_vecs = g_vecs.at[:, S_WOUT, :32].set(g_wout)
+    g_vecs = g_vecs.at[:, S_BOUT, 0].set(g_bout)
+
+    grads = {"w_in": g_win, "w_sq": g_wsq, "w_ff1": g_wff1, "w_ff2": g_wff2,
+             "w_h1": g_wh1, "w_h2": g_wh2, "vecs": g_vecs}
+
+    # ---------------- clip + Adam ----------------
+    if clip > 0.0:
+        gn2 = jnp.zeros((G,), jnp.float32)
+        for k in GROUP_ORDER:
+            g = grads[k]
+            gn2 = gn2 + jnp.sum(g * g, axis=tuple(range(1, g.ndim)))
+        scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(gn2), 1e-12))
+    else:
+        scale = jnp.ones((G,), jnp.float32)
+
+    t = (sc_ref[1] + j + 1).astype(jnp.float32)
+    bc1 = 1.0 - B1 ** t
+    bc2 = 1.0 - B2 ** t
+    for k, mp, vp, pp in zip(GROUP_ORDER, m_out, v_out, p_out):
+        g = grads[k] * scale.reshape((G,) + (1,) * (grads[k].ndim - 1))
+        m_new = B1 * mp[...] + (1.0 - B1) * g
+        v_new = B2 * vp[...] + (1.0 - B2) * (g * g)
+        mp[...] = m_new
+        vp[...] = v_new
+        pp[...] = pp[...] - lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + EPS)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrapper
+# ---------------------------------------------------------------------------
+
+def run_epoch(groups_p, groups_m, groups_v, batches, seed, t_offset, *,
+              lr, clip, drop_attn=0.1, drop_block=0.1, drop_head=0.3,
+              g_clients=8, interpret=False):
+    """One epoch of fused Adam steps.
+
+    groups_*: dicts of packed [C_pad, ...] arrays (C_pad % g_clients == 0).
+    batches: [C_pad, nb, B, 32] pre-gathered minibatches
+             (cols 0:7 vitals, 7:23 labs, 23 label, 24 mask).
+    Returns (new_p, new_m, new_v, loss_sums [C_pad] — per-client SUM of the
+    nb per-step masked-mean losses; divide by nb for the epoch mean).
+    """
+    C_pad, nb, B, _ = batches.shape
+    G = g_clients
+    assert C_pad % G == 0, (C_pad, G)
+    assert G % 8 == 0, "loss block layout requires g_clients % 8 == 0"
+    chunks = C_pad // G
+
+    p_list = [groups_p[k] for k in GROUP_ORDER]
+    m_list = [groups_m[k] for k in GROUP_ORDER]
+    v_list = [groups_v[k] for k in GROUP_ORDER]
+
+    def gspec(arr):
+        nd = arr.ndim
+        return pl.BlockSpec((G,) + arr.shape[1:],
+                            lambda i, j, sc, nd=nd: (i,) + (0,) * (nd - 1),
+                            memory_space=pltpu.VMEM)
+
+    state_specs = [gspec(a) for a in p_list + m_list + v_list]
+    batch_spec = pl.BlockSpec((G, 1, B, 32), lambda i, j, sc: (i, j, 0, 0),
+                              memory_space=pltpu.VMEM)
+    loss_spec = pl.BlockSpec((G, 128), lambda i, j, sc: (i, 0),
+                             memory_space=pltpu.VMEM)
+
+    out_shapes = ([jax.ShapeDtypeStruct((C_pad, 128), jnp.float32)]
+                  + [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in p_list + m_list + v_list])
+    out_specs = [loss_spec] + state_specs
+
+    # inputs (after the scalar-prefetch arg): 21 state arrays, then batches.
+    # alias state input k -> output k+1 (output 0 is the loss).
+    aliases = {1 + k: 1 + k for k in range(3 * N_G)}
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(chunks, nb),
+        in_specs=state_specs + [batch_spec],
+        out_specs=out_specs,
+    )
+    kernel = functools.partial(
+        _train_step_kernel, lr=float(lr), clip=float(clip),
+        drop_attn=float(drop_attn), drop_block=float(drop_block),
+        drop_head=float(drop_head), g_clients=G, batch_b=B,
+    )
+    sc = jnp.asarray([seed, t_offset, 0], jnp.int32)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(sc, *p_list, *m_list, *v_list, batches)
+
+    loss_sums = outs[0][:, 0]
+    new_p = dict(zip(GROUP_ORDER, outs[1:1 + N_G]))
+    new_m = dict(zip(GROUP_ORDER, outs[1 + N_G:1 + 2 * N_G]))
+    new_v = dict(zip(GROUP_ORDER, outs[1 + 2 * N_G:1 + 3 * N_G]))
+    return new_p, new_m, new_v, loss_sums
+
+
+def zeros_like_groups(groups: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
+    return {k: jnp.zeros_like(v) for k, v in groups.items()}
+
+
+def build_fused_local_update(dataset, *, epochs, batch_size, lr,
+                             clip_grad_norm, dropout=(0.1, 0.1, 0.3),
+                             g_clients=8, interpret=False):
+    """Drop-in batched replacement for vmap(build_local_update(...)).
+
+    Returns ``batched(params, keys [C], idx [C, hi], mask [C, hi]) ->
+    (stacked_params [C, ...], ok [C] bool, loss [C])`` with the same
+    shuffling/padding semantics as training/local.build_local_update (the
+    per-epoch permutation of the PADDED index array, scattered mask rows,
+    fixed nb steps — see its docstring); only the dropout stream differs
+    (hardware PRNG inside the kernel vs flax threefry/rbg).
+    """
+    feats = jnp.concatenate(
+        [dataset["vitals"], dataset["labs"], dataset["label"][:, None]], axis=1
+    ).astype(jnp.float32)                                     # [N, 24]
+    B = batch_size
+    G = g_clients
+
+    def batched(params, keys, idx, mask):
+        C, hi = idx.shape
+        nb = -(-hi // B)
+        pad = nb * B - hi
+        C_pad = -(-C // G) * G
+
+        # broadcast unstacked params ([...]) to the client axis ([C, ...])
+        stacked = params
+        if params["fc1"]["kernel"].ndim == 2:
+            stacked = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (C,) + x.shape), params)
+        padded = jax.tree.map(
+            lambda x: jnp.concatenate(
+                [x, jnp.zeros((C_pad - C,) + x.shape[1:], x.dtype)], axis=0)
+            if C_pad != C else x,
+            stacked)
+
+        gp = pack_params(padded)
+        gm = zeros_like_groups(gp)
+        gv = zeros_like_groups(gp)
+
+        # same per-client key schedule as the JAX path (local.py):
+        # per client: epoch keys = split(rng, E); per epoch (k_perm, k_drop)
+        eks = jax.vmap(lambda k: jax.random.split(k, epochs))(keys)  # [C,E,...]
+        seed0 = jax.random.randint(keys[0], (), 0, np.int32(2 ** 31 - 1))
+
+        loss_sums = None
+        ok = jnp.ones((C,), bool)
+        for e in range(epochs):
+            k_perm = jax.vmap(lambda k: jax.random.split(k[e])[0])(eks)
+            perms = jax.vmap(lambda k: jax.random.permutation(k, hi))(k_perm)
+            p_idx = jnp.take_along_axis(idx, perms, axis=1)
+            p_msk = jnp.take_along_axis(mask.astype(jnp.float32), perms, axis=1)
+            bidx = jnp.pad(p_idx, ((0, 0), (0, pad))).reshape(C, nb, B)
+            bmsk = jnp.pad(p_msk, ((0, 0), (0, pad))).reshape(C, nb, B)
+            batch = jnp.concatenate(
+                [feats[bidx],                                  # [C,nb,B,24]
+                 bmsk[..., None],
+                 jnp.zeros((C, nb, B, 7), jnp.float32)], axis=-1)
+            if C_pad != C:
+                batch = jnp.concatenate(
+                    [batch, jnp.zeros((C_pad - C, nb, B, 32), jnp.float32)],
+                    axis=0)
+            gp, gm, gv, sums = run_epoch(
+                gp, gm, gv, batch, seed0 + np.int32(e), e * nb,
+                lr=lr, clip=clip_grad_norm if clip_grad_norm else 0.0,
+                drop_attn=dropout[0], drop_block=dropout[1],
+                drop_head=dropout[2], g_clients=G, interpret=interpret)
+            ok = ok & jnp.isfinite(sums[:C])
+            loss_sums = sums
+        new_stacked = unpack_params(gp, padded)
+        if C_pad != C:
+            new_stacked = jax.tree.map(lambda x: x[:C], new_stacked)
+        return new_stacked, ok, loss_sums[:C] / nb
+
+    return batched
