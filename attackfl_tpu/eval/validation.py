@@ -38,7 +38,16 @@ def roc_auc(labels: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
     n_pos = jnp.sum(labels)
     n_neg = labels.shape[0] - n_pos
     rank_sum = jnp.sum(jnp.where(labels > 0.5, avg_rank, 0.0))
-    return (rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg)
+    denom = n_pos * n_neg
+    # single-class labels make AUC undefined — return NaN explicitly
+    # (instead of a 0/0 or x/0 artifact) so callers can gate on finiteness;
+    # the reference fails the round via sklearn's exception there
+    # (src/Validation.py:104-122)
+    return jnp.where(
+        denom > 0,
+        (rank_sum - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(denom, 1.0),
+        jnp.nan,
+    )
 
 
 def _forward_in_chunks(apply_fn: Callable, data: Batch, chunk: int = 4096):
@@ -62,8 +71,8 @@ def evaluate_icu(model, params: Any, test_data: Batch) -> dict[str, jnp.ndarray]
         lambda b: model.apply({"params": params}, b["vitals"], b["labs"])[:, 0],
         test_data,
     )
-    ok = ~jnp.any(jnp.isnan(probs))
     auc_val = roc_auc(test_data["label"], probs)
+    ok = ~jnp.any(jnp.isnan(probs)) & jnp.isfinite(auc_val)
     return {"roc_auc": auc_val, "ok": ok, "metric": auc_val}
 
 
@@ -102,10 +111,10 @@ def evaluate_hyper_icu(model, stacked_params: Any, test_data: Batch) -> dict[str
         )
 
     probs = jax.lax.map(one_client, stacked_params)  # (C, N)
-    ok = ~jnp.any(jnp.isnan(probs))
     n_clients = probs.shape[0]
     labels = jnp.tile(test_data["label"], n_clients)
     auc_val = roc_auc(labels, probs.reshape(-1))
+    ok = ~jnp.any(jnp.isnan(probs)) & jnp.isfinite(auc_val)
     return {"roc_auc": auc_val, "ok": ok, "metric": auc_val}
 
 

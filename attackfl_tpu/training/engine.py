@@ -98,6 +98,12 @@ class Simulator:
                 f"[mesh] {cfg.total_clients} clients not divisible by "
                 f"{self.mesh.size} devices; running replicated.", "yellow")
             self.mesh = None
+        if cfg.local_backend == "pallas" and self.mesh is not None:
+            raise ValueError(
+                "local_backend 'pallas' is the single-chip fused fast path; "
+                "it does not shard over the client mesh (use local_backend "
+                "'xla' with use_mesh, or drop the mesh)"
+            )
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
         # ---- validation -------------------------------------------------
@@ -415,7 +421,12 @@ class Simulator:
                     gen_params, _ = generate_all(new_hp)
                     ev = eval_fn(stacked_params=gen_params)
                     ok = ok & ev.pop("ok")
-                    metrics.update(ev)
+                    # run_round skips validation entirely when training
+                    # failed; the scan body can't skip, so mask the metrics
+                    # of train-failed rounds to NaN for history parity
+                    metrics.update(
+                        {k: jnp.where(train_ok, v, jnp.nan) for k, v in ev.items()}
+                    )
                 new_state = {
                     "hnet_params": accept(ok, new_hp, state["hnet_params"]),
                     "hyper_opt_state": accept(ok, new_opt, state["hyper_opt_state"]),
@@ -449,7 +460,10 @@ class Simulator:
                 if eval_fn is not None:
                     ev = eval_fn(params=new_global)
                     ok = ok & ev.pop("ok")
-                    metrics.update(ev)
+                    # mask train-failed rounds' val metrics (see hyper body)
+                    metrics.update(
+                        {k: jnp.where(train_ok, v, jnp.nan) for k, v in ev.items()}
+                    )
                 new_state = {
                     "global_params": accept(ok, new_global, state["global_params"]),
                     "prev_genuine": accept(train_ok, new_gen, state["prev_genuine"]),

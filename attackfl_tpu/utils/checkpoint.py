@@ -41,7 +41,19 @@ def save_state(path: str, state: Any) -> None:
 def load_state(path: str, template: Any) -> Any:
     with open(path, "rb") as fh:
         data = fh.read()
-    loaded = serialization.from_bytes(_strip_keys(template), data)
+    try:
+        loaded = serialization.from_bytes(_strip_keys(template), data)
+    except ValueError as e:
+        # A shape/structure mismatch inside from_bytes fires before the
+        # rng rewrap below can diagnose it — the common cause is a
+        # checkpoint written under a different prng_impl (threefry key
+        # data is shape (2,), rbg is (4,)).
+        raise ValueError(
+            f"checkpoint {path!r} does not match the current state "
+            "structure; the most common cause is a checkpoint written "
+            "with a different prng_impl (or an older config) — rerun "
+            "with the original settings or delete the checkpoint"
+        ) from e
 
     # re-wrap raw key data with the template's prng impl
     def _rewrap(t, l):

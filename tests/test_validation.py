@@ -40,6 +40,7 @@ def test_validation_icu_gate(rng):
     assert not ok_bad
 
 
+@pytest.mark.slow
 def test_validation_har(rng):
     model = get_model("TransformerClassifier")
     test_data = make_dataset("HAR", 64, seed=3)
@@ -64,3 +65,21 @@ def test_validation_unknown_data():
     model = get_model("TransformerModel")
     with pytest.raises(ValueError):
         Validation(model, "MNIST", {"x": np.zeros((4, 2))})
+
+
+def test_roc_auc_single_class_is_nan_and_fails_round(rng):
+    """Single-class test labels make AUC undefined: the metric must be NaN
+    (not an inf/0-div artifact) and the round must FAIL, matching the
+    reference's sklearn exception path (src/Validation.py:104-122)."""
+    ones = jnp.ones((8,))
+    assert bool(jnp.isnan(roc_auc(ones, jnp.linspace(0, 1, 8))))
+    assert bool(jnp.isnan(roc_auc(jnp.zeros((8,)), jnp.linspace(0, 1, 8))))
+
+    model = get_model("TransformerModel")
+    test_data = make_dataset("ICU", 64, seed=3)
+    test_data["label"] = np.ones_like(np.asarray(test_data["label"]))  # degenerate
+    val = Validation(model, "ICU", test_data)
+    params = model.init(rng, jnp.ones((1, 7)), jnp.ones((1, 16)))["params"]
+    ok, metrics = val.test(params)
+    assert not ok
+    assert np.isnan(metrics["roc_auc"])
