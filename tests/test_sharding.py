@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.parallel.mesh import (
     client_sharding,
     make_client_mesh,
@@ -40,26 +41,108 @@ def test_constrain_noop_without_mesh():
     assert fn(x) is x
 
 
-def test_sharded_simulation_matches_replicated():
+def _max_abs_diff(tree_a, tree_b):
+    return max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(tree_a), jax.tree.leaves(tree_b))
+    )
+
+
+def test_sharded_one_round_matches_replicated():
     """The same config, same seed, run sharded over 8 devices and
-    unsharded, must produce (numerically close) identical global models —
-    sharding is placement, not semantics."""
-    cfg = Config(num_round=2, total_clients=8, mode="fedavg",
-                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=2),),
+    unsharded, must produce numerically-equal global models after ONE
+    round — sharding is placement, not semantics.  (One round only: the
+    sharded mean reduces in a different association order, and Adam's
+    rsqrt amplifies that ~1e-7 float noise by ~1e3x per round, so a
+    multi-round bitwise comparison is meaningless — see the 2-round
+    metric test below for trajectory-level equivalence.)"""
+    cfg = Config(num_round=1, total_clients=8, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=1),),
                  **BASE)
+
+    def seeded(sim):
+        # attacks are gated on have_genuine (round.py); a fresh round 1 has
+        # no leaked genuine set, so seed one (the initial params broadcast
+        # to the genuine rows) to exercise leak-gather + LIE + scatter
+        # under the mesh within this single bitwise-compared round
+        state = sim.init_state()
+        state["prev_genuine"] = pt.tree_broadcast(
+            state["global_params"], len(sim.genuine_idx))
+        state["have_genuine"] = np.asarray(True)
+        return state
+
     sim_plain = Simulator(cfg)
-    state_p, hist_p = sim_plain.run(save_checkpoints=False, verbose=False)
+    state_p, hist_p = sim_plain.run(
+        state=seeded(sim_plain), save_checkpoints=False, verbose=False)
 
     sim_mesh = Simulator(cfg, use_mesh=True)
     assert sim_mesh.mesh is not None and sim_mesh.mesh.size == 8
-    state_m, hist_m = sim_mesh.run(save_checkpoints=False, verbose=False)
+    state_m, hist_m = sim_mesh.run(
+        state=seeded(sim_mesh), save_checkpoints=False, verbose=False)
 
     for a, b in zip(
         jax.tree.leaves(state_p["global_params"]),
         jax.tree.leaves(state_m["global_params"]),
     ):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
-    assert abs(hist_p[-1]["roc_auc"] - hist_m[-1]["roc_auc"]) < 1e-2
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert abs(hist_p[-1]["roc_auc"] - hist_m[-1]["roc_auc"]) < 1e-3
+
+
+@pytest.mark.slow
+def test_sharded_trajectory_metrics_match_replicated():
+    """Over multiple rounds bitwise parity is impossible (reduction-order
+    noise through Adam) — instead the *trajectories* must stay close:
+    per-round quality metrics agree and params stay within a drift bound."""
+    cfg = Config(num_round=3, total_clients=8, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=2),),
+                 **BASE)
+    state_p, hist_p = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    state_m, hist_m = Simulator(cfg, use_mesh=True).run(
+        save_checkpoints=False, verbose=False)
+
+    for mp, mm in zip(hist_p, hist_m):
+        assert mp["ok"] == mm["ok"]
+        assert abs(mp["roc_auc"] - mm["roc_auc"]) < 2e-2
+    assert _max_abs_diff(state_p["global_params"], state_m["global_params"]) < 5e-3
+
+
+@pytest.mark.slow
+def test_sharded_fused_scan_matches_replicated():
+    """The run_scan fast path (whole multi-round program as one lax.scan
+    dispatch) must agree with its replicated self on the 8-device mesh."""
+    cfg = Config(num_round=2, total_clients=8, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=2),),
+                 **BASE)
+    sim_p = Simulator(cfg)
+    state_p, m_p = sim_p.run_scan(sim_p.init_state(), 2)
+    sim_m = Simulator(cfg, use_mesh=True)
+    assert sim_m.mesh is not None
+    state_m, m_m = sim_m.run_scan(sim_m.init_state(), 2)
+
+    np.testing.assert_array_equal(np.asarray(m_p["ok"]), np.asarray(m_m["ok"]))
+    np.testing.assert_allclose(
+        np.asarray(m_p["roc_auc"]), np.asarray(m_m["roc_auc"]), atol=2e-2)
+    assert _max_abs_diff(state_p["global_params"], state_m["global_params"]) < 5e-3
+
+
+@pytest.mark.slow
+def test_sharded_hyper_matches_replicated():
+    """Hyper (pFedHN) mode: per-client generated weights + sequential
+    hnet update must behave identically under the client mesh."""
+    cfg = Config(num_round=1, total_clients=8, mode="hyper", **BASE)
+    state_p, hist_p = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    sim_m = Simulator(cfg, use_mesh=True)
+    assert sim_m.mesh is not None
+    state_m, hist_m = sim_m.run(save_checkpoints=False, verbose=False)
+
+    assert hist_p[-1]["ok"] == hist_m[-1]["ok"]
+    assert abs(hist_p[-1]["roc_auc"] - hist_m[-1]["roc_auc"]) < 2e-2
+    # Early Adam steps move ±hyper_lr per element regardless of gradient
+    # magnitude, so 1e-7 reduction-order noise on a near-zero gradient
+    # flips a whole ±lr step: the honest per-element bound after 8
+    # sequential client steps is ~2*lr*8, not float noise.
+    bound = 2 * cfg.hyper_lr * cfg.total_clients + 1e-4
+    assert _max_abs_diff(state_p["hnet_params"], state_m["hnet_params"]) < bound
 
 
 def test_indivisible_clients_fall_back():
