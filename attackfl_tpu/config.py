@@ -146,6 +146,11 @@ class Config:
     # fuse across consecutive optimizer steps (~10% faster rounds at 4) at
     # the cost of proportionally longer compiles; 1 = cheapest compile.
     scan_unroll: int = 1
+    # Local-training backend: "xla" (vmapped lax.scan autodiff path, any
+    # model) or "pallas" (ops/fused_step hand-fused TPU mega-kernel:
+    # forward+backward+clip+Adam as one kernel per minibatch grid step;
+    # TransformerModel on ICU only).
+    local_backend: str = "xla"
     # Synthetic dataset sizes (reference blobs are absent,
     # .MISSING_LARGE_BLOBS): train/test sample counts.
     train_size: int = 20000
@@ -161,6 +166,23 @@ class Config:
             )
         if self.scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.local_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"Unknown local_backend {self.local_backend!r}; choose xla or pallas"
+            )
+        if self.local_backend == "pallas" and (
+            self.model != "TransformerModel" or self.data_name != "ICU"
+        ):
+            raise ValueError(
+                "local_backend 'pallas' implements the flagship "
+                "TransformerModel-on-ICU step only; use local_backend 'xla'"
+            )
+        if self.local_backend == "pallas" and self.mode == "hyper":
+            raise ValueError(
+                "local_backend 'pallas' fuses the plain local-training step; "
+                "hyper mode trains against per-client generated weights and "
+                "runs on the xla backend only"
+            )
         if self.mode not in AGGREGATION_MODES:
             raise ValueError(f"Unknown server mode {self.mode!r}; choose from {AGGREGATION_MODES}")
         if self.data_name not in DATA_NAMES:
@@ -261,6 +283,7 @@ def config_from_dict(raw: dict) -> Config:
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),
+        local_backend=str(_get(mesh, "local-backend", defaults.local_backend)),
         krum_f=int(_get(server, "krum-f", defaults.krum_f)),
         trim_ratio=float(_get(server, "trim-ratio", defaults.trim_ratio)),
         train_size=int(_get(server, "train-size", defaults.train_size)),

@@ -475,6 +475,12 @@ def build_fused_local_update(dataset, *, epochs, batch_size, lr,
     fixed nb steps — see its docstring); only the dropout stream differs
     (hardware PRNG inside the kernel vs flax threefry/rbg).
     """
+    if interpret:
+        # the TPU hardware-PRNG primitives (prng_seed/prng_random_bits)
+        # have no CPU interpret lowering — interpret mode is the CI
+        # correctness path, so it runs dropout-off (the deterministic
+        # configuration the parity test checks); hardware runs keep dropout
+        dropout = (0.0, 0.0, 0.0)
     feats = jnp.concatenate(
         [dataset["vitals"], dataset["labs"], dataset["label"][:, None]], axis=1
     ).astype(jnp.float32)                                     # [N, 24]

@@ -102,12 +102,33 @@ def build_round_step(
     leak_k = max(int(cfg.genuine_rate * num_genuine), 1)
     genuine_arr = jnp.asarray(genuine_idx, dtype=jnp.int32)
 
-    local_update = build_local_update(
-        model, cfg.data_name, train_data,
-        epochs=cfg.epochs, batch_size=cfg.batch_size,
-        lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
-        scan_unroll=cfg.scan_unroll,
-    )
+    if cfg.local_backend == "pallas":
+        from attackfl_tpu.ops import fused_step
+        from attackfl_tpu.utils.logging import print_with_color
+
+        interpret = jax.default_backend() != "tpu"
+        if interpret:
+            print_with_color(
+                "[pallas] no TPU backend: running the fused kernel in "
+                "INTERPRET mode (slow, dropout forced off) — a correctness "
+                "path, not a fast path; use local_backend 'xla' off-TPU.",
+                "yellow")
+        # dropout rates mirror TransformerModel: block/attention 0.1
+        # (models/icu.py TransformerBlock call), head = model.dropout_rate
+        batched_update = fused_step.build_fused_local_update(
+            train_data, epochs=cfg.epochs, batch_size=cfg.batch_size,
+            lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
+            dropout=(0.1, 0.1, float(getattr(model, "dropout_rate", 0.3))),
+            interpret=interpret,
+        )
+    else:
+        local_update = build_local_update(
+            model, cfg.data_name, train_data,
+            epochs=cfg.epochs, batch_size=cfg.batch_size,
+            lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
+            scan_unroll=cfg.scan_unroll,
+        )
+        batched_update = jax.vmap(local_update, in_axes=(None, 0, 0, 0))
     constrain = constrain or (lambda tree: tree)
 
     def round_step(global_params, prev_genuine, have_genuine, rng, broadcast_number):
@@ -117,9 +138,7 @@ def build_round_step(
         )
         idx, mask = constrain(idx), constrain(mask)
         train_keys = constrain(jax.random.split(k_train, num_clients))
-        stacked, ok, losses = jax.vmap(local_update, in_axes=(None, 0, 0, 0))(
-            global_params, train_keys, idx, mask
-        )
+        stacked, ok, losses = batched_update(global_params, train_keys, idx, mask)
         stacked = constrain(stacked)
 
         for gi, grp in enumerate(attack_groups):

@@ -1,0 +1,135 @@
+"""Pallas fused local-training step (ops/fused_step) — interpret-mode CI.
+
+The kernel hand-derives the TransformerModel forward+backward+clip+Adam
+(reference semantics: client.train_ICU, /root/reference/client.py:74-112,
+with the clip-before-backward bug fixed).  With dropout forced to 0 it is
+deterministic and must match jax.grad of the flax model bit-for-bit-ish;
+hardware-only behavior (Mosaic layouts, input_output_aliases with scalar
+prefetch) is exercised by the TPU bench, not here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.models.icu import TransformerModel
+from attackfl_tpu.ops import fused_step as fs
+from attackfl_tpu.training.engine import Simulator
+
+C, B, N = 8, 16, 64
+EPOCHS = 2
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerModel(seq1_fast=True)
+
+
+@pytest.fixture(scope="module")
+def data():
+    vit = jax.random.normal(jax.random.PRNGKey(1), (N, 7))
+    labs = jax.random.normal(jax.random.PRNGKey(2), (N, 16))
+    lab = (jax.random.uniform(jax.random.PRNGKey(3), (N,)) > 0.5).astype(jnp.float32)
+    return {"vitals": vit, "labs": labs, "label": lab}
+
+
+@pytest.fixture(scope="module")
+def params(model, data):
+    return model.init(jax.random.PRNGKey(0), data["vitals"][:1], data["labs"][:1])["params"]
+
+
+def test_pack_unpack_roundtrip(params):
+    stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (C,) + x.shape), params)
+    groups = fs.pack_params(stacked)
+    rt = fs.unpack_params(groups, stacked)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(stacked),
+        jax.tree_util.tree_leaves_with_path(rt),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+
+def _jax_reference_one_client(model, data, params, key, cidx, cmask):
+    """Mirror of the kernel's epoch loop: same perm schedule, same padded
+    minibatching, optax clip+Adam, dropout off."""
+
+    def loss_fn(p, bvit, blabs, by, bm):
+        probs = model.apply({"params": p}, bvit, blabs)[:, 0]
+        probs = jnp.clip(probs, 1e-7, 1 - 1e-7)
+        per = -(by * jnp.log(probs) + (1 - by) * jnp.log(1 - probs))
+        return jnp.sum(per * bm) / jnp.maximum(jnp.sum(bm), 1.0)
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(0.004))
+    p, opt = params, tx.init(params)
+    eks = jax.random.split(key, EPOCHS)
+    hi = cidx.shape[0]
+    nb = -(-hi // B)
+    pad = nb * B - hi
+    last_epoch_loss = 0.0
+    for e in range(EPOCHS):
+        k_perm, _ = jax.random.split(eks[e])
+        perm = jax.random.permutation(k_perm, hi)
+        bidx = jnp.pad(cidx[perm], (0, pad)).reshape(nb, B)
+        bmask = jnp.pad(cmask[perm].astype(jnp.float32), (0, pad)).reshape(nb, B)
+        el = 0.0
+        for j in range(nb):
+            l, g = jax.value_and_grad(loss_fn)(
+                p, data["vitals"][bidx[j]], data["labs"][bidx[j]],
+                data["label"][bidx[j]], bmask[j],
+            )
+            u, opt = tx.update(g, opt, p)
+            p = optax.apply_updates(p, u)
+            el += l
+        last_epoch_loss = el / nb
+    return p, last_epoch_loss
+
+
+@pytest.mark.slow
+def test_kernel_matches_autodiff(model, data, params):
+    """Dropout-off kernel step == jax.grad of the flax model through two
+    epochs of clipped Adam (the _tkm verification, promoted to CI)."""
+    keys = jax.random.split(jax.random.PRNGKey(9), C)
+    idx = jnp.stack(
+        [jax.random.permutation(jax.random.PRNGKey(100 + i), N)[:48] for i in range(C)]
+    )
+    mask = jnp.ones((C, 48), bool)
+
+    upd = fs.build_fused_local_update(
+        data, epochs=EPOCHS, batch_size=B, lr=0.004, clip_grad_norm=1.0,
+        dropout=(0, 0, 0), g_clients=8, interpret=True,
+    )
+    new_p, ok, loss = upd(params, keys, idx, mask)
+    assert bool(np.asarray(ok).all())
+
+    ref_p0, ref_loss0 = _jax_reference_one_client(
+        model, data, params, keys[0], idx[0], mask[0]
+    )
+    kp0 = jax.tree.map(lambda x: x[0], new_p)
+    flat_k = jnp.concatenate([x.ravel() for x in jax.tree.leaves(kp0)])
+    flat_r = jnp.concatenate([x.ravel() for x in jax.tree.leaves(ref_p0)])
+    assert float(jnp.abs(flat_k - flat_r).max()) < 2e-4
+    assert abs(float(loss[0]) - float(ref_loss0)) < 1e-4
+
+
+@pytest.mark.slow
+def test_pallas_backend_round(data):
+    """End-to-end: a Simulator round with local_backend='pallas' (interpret
+    mode on CPU) trains, attacks and validates green."""
+    cfg = Config(
+        num_round=1, total_clients=8, mode="fedavg", model="TransformerModel",
+        data_name="ICU", num_data_range=(32, 48), epochs=1, batch_size=16,
+        train_size=64, test_size=64, local_backend="pallas",
+        attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=1),),
+        log_path=".", checkpoint_dir=".",
+    )
+    state, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert hist[-1]["ok"]
+    assert np.isfinite(hist[-1]["roc_auc"])
+
+
+def test_pallas_backend_config_validation():
+    with pytest.raises(ValueError, match="pallas"):
+        Config(model="CNNModel", local_backend="pallas")
