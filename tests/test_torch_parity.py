@@ -1,0 +1,64 @@
+"""Accuracy parity vs the PyTorch transcription of the reference algorithm.
+
+BASELINE.json's tracked metric is "final test-acc parity vs PyTorch";
+SURVEY.md §7 defines parity as final-METRIC parity (the rng streams of
+torch and JAX are incomparable, so trajectories can't match bitwise).
+Both sides train on the identical synthetic arrays
+(data/synthetic.make_dataset) at a reduced scale that still separates a
+learning model (AUC >= 0.75) from a broken one (~0.5).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import torch_parity  # noqa: E402
+from attackfl_tpu.config import AttackSpec, Config  # noqa: E402
+from attackfl_tpu.training.engine import Simulator  # noqa: E402
+
+TRAIN, TEST = 2048, 1024
+NDR = (256, 384)
+TOL = 0.08
+
+
+def _jax_auc(cfg: Config) -> float:
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert hist[-1]["ok"]
+    return hist[-1]["roc_auc"]
+
+
+@pytest.mark.slow
+def test_parity_config1_cnn_fedavg():
+    """BASELINE config 1: CNNModel, 3 clients, FedAvg, no attack."""
+    cfg = Config(num_round=5, total_clients=3, mode="fedavg", model="CNNModel",
+                 data_name="ICU", num_data_range=NDR, epochs=2, batch_size=128,
+                 train_size=TRAIN, test_size=TEST, log_path=".", checkpoint_dir=".")
+    jax_auc = _jax_auc(cfg)
+    torch_out = torch_parity.run(
+        1, clients=3, rounds=5, epochs=2, batch_size=128,
+        num_data_range=NDR, train_size=TRAIN, test_size=TEST)
+    assert np.isfinite(torch_out["final_roc_auc"])
+    assert jax_auc > 0.7 and torch_out["final_roc_auc"] > 0.7
+    assert abs(jax_auc - torch_out["final_roc_auc"]) < TOL
+
+
+@pytest.mark.slow
+def test_parity_config4_transformer_lie():
+    """BASELINE config 4 (reduced): TransformerModel, 8 clients, 2 LIE
+    attackers, genuine-rate 0.5."""
+    cfg = Config(num_round=5, total_clients=8, mode="fedavg",
+                 model="TransformerModel", data_name="ICU", num_data_range=NDR,
+                 epochs=2, batch_size=128, train_size=TRAIN, test_size=TEST,
+                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=2),),
+                 log_path=".", checkpoint_dir=".")
+    jax_auc = _jax_auc(cfg)
+    torch_out = torch_parity.run(
+        4, clients=8, rounds=5, epochs=2, batch_size=128,
+        num_data_range=NDR, train_size=TRAIN, test_size=TEST, attackers=2)
+    assert np.isfinite(torch_out["final_roc_auc"])
+    assert jax_auc > 0.7 and torch_out["final_roc_auc"] > 0.7
+    assert abs(jax_auc - torch_out["final_roc_auc"]) < TOL

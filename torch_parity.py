@@ -1,0 +1,277 @@
+"""PyTorch baseline harness for accuracy/throughput parity.
+
+This is a fresh PyTorch transcription of the reference testbed's
+*algorithm* — local training (BCE + Adam, epochs x minibatches,
+/root/reference/client.py:66-112), per-round quantity-skew client
+subsampling (/root/reference/src/RpcClient.py:97,166-169), size-weighted
+FedAvg (/root/reference/server.py:751-775), the genuine-model leak channel
+(/root/reference/server.py:596-616) and the LIE attack (mean + z*std,
+/root/reference/src/Utils.py:83-98,207-214) — run single-process on the
+SAME synthetic arrays the JAX framework trains on, so final-metric parity
+(SURVEY.md §7: parity = final-metric, not bitwise) is measurable.
+
+Deliberate divergence from the reference (matching the framework's
+documented fixes, SURVEY.md §2 quirks): grad clipping happens AFTER
+backward (the reference clips stale grads, client.py:104-106), and the
+LIE attack deep-copies instead of mutating the leaked models in place
+(Utils.py:209-212).
+
+Usage:  python torch_parity.py --config 1|4 [--clients N] [--rounds R]
+Prints one JSON line: {"config":…, "final_roc_auc":…, "rounds_per_sec":…}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import math
+import random
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+from attackfl_tpu.data.synthetic import make_dataset
+
+
+# ---------------------------------------------------------------------------
+# torch models (architecture parity with src/Model.py:27-88,194-246)
+# ---------------------------------------------------------------------------
+
+class TorchCNN(nn.Module):
+    """Dual-branch 1D CNN (reference CNNModel, src/Model.py:27-88)."""
+
+    def __init__(self):
+        super().__init__()
+
+        def branch():
+            return nn.Sequential(
+                nn.Conv1d(1, 32, 3, padding=1), nn.ReLU(),
+                nn.Conv1d(32, 64, 3, padding=1), nn.ReLU(),
+                nn.Conv1d(64, 128, 3, padding=1), nn.ReLU(),
+                nn.AdaptiveAvgPool1d(4), nn.Flatten(), nn.Dropout(0.3),
+            )
+
+        self.vitals = branch()
+        self.labs = branch()
+        self.head = nn.Sequential(
+            nn.Linear(1024, 128), nn.ReLU(),
+            nn.Linear(128, 64), nn.ReLU(),
+            nn.Linear(64, 32), nn.ReLU(),
+            nn.Linear(32, 1), nn.Sigmoid(),
+        )
+
+    def forward(self, vitals, labs):
+        v = self.vitals(vitals[:, None, :])
+        l = self.labs(labs[:, None, :])
+        return self.head(torch.cat([v, l], dim=1))
+
+
+class _Branch(nn.Module):
+    """One TransformerModel branch: Dense+GELU -> 1-token transformer
+    block -> LayerNorm (src/Model.py:166-246)."""
+
+    def __init__(self, in_dim: int):
+        super().__init__()
+        self.proj = nn.Linear(in_dim, 64)
+        self.attn = nn.MultiheadAttention(64, 4, batch_first=True)
+        self.ln1 = nn.LayerNorm(64)
+        self.ffn = nn.Sequential(nn.Linear(64, 6), nn.GELU(), nn.Linear(6, 64))
+        self.ln2 = nn.LayerNorm(64)
+        self.ln3 = nn.LayerNorm(64)
+        self.drop = nn.Dropout(0.1)
+
+    def forward(self, x):
+        x = torch.nn.functional.gelu(self.proj(x))[:, None, :]  # seq len 1
+        a, _ = self.attn(x, x, x, need_weights=False)
+        x = self.ln1(x + self.drop(a))
+        x = self.ln2(x + self.drop(self.ffn(x)))
+        return self.ln3(x[:, 0, :])
+
+
+class TorchTransformer(nn.Module):
+    """Reference TransformerModel (src/Model.py:194-246)."""
+
+    def __init__(self):
+        super().__init__()
+        self.vitals = _Branch(7)
+        self.labs = _Branch(16)
+        self.fc1 = nn.Linear(128, 64)
+        self.drop = nn.Dropout(0.3)
+        self.fc2 = nn.Linear(64, 32)
+        self.out = nn.Linear(32, 1)
+
+    def forward(self, vitals, labs):
+        x = torch.cat([self.vitals(vitals), self.labs(labs)], dim=1)
+        x = self.drop(torch.nn.functional.gelu(self.fc1(x)))
+        x = torch.nn.functional.gelu(self.fc2(x))
+        return torch.sigmoid(self.out(x))
+
+
+# ---------------------------------------------------------------------------
+# the reference algorithm
+# ---------------------------------------------------------------------------
+
+def train_local(model, state_dict, data, idx, *, epochs, batch_size, lr, clip):
+    """One client's local training (reference: client.train_ICU,
+    client.py:74-112 — BCE, Adam, fresh optimizer per round)."""
+    model.load_state_dict(state_dict)
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    loss_fn = nn.BCELoss()
+    vit = torch.from_numpy(data["vitals"][idx])
+    labs = torch.from_numpy(data["labs"][idx])
+    y = torch.from_numpy(data["label"][idx])
+    n = len(idx)
+    for _ in range(epochs):
+        perm = torch.randperm(n)
+        for s in range(0, n, batch_size):
+            b = perm[s:s + batch_size]
+            if len(b) == 0:
+                continue
+            opt.zero_grad()
+            probs = model(vit[b], labs[b])[:, 0].clamp(1e-7, 1 - 1e-7)
+            loss = loss_fn(probs, y[b])
+            if not torch.isfinite(loss):
+                return None
+            loss.backward()
+            if clip:
+                torch.nn.utils.clip_grad_norm_(model.parameters(), clip)
+            opt.step()
+    return {k: v.detach().clone() for k, v in model.state_dict().items()}
+
+
+def fedavg(updates, sizes):
+    """Size-weighted average (reference: avg_all_parameters,
+    server.py:751-775)."""
+    total = float(sum(sizes))
+    out = {}
+    for k in updates[0]:
+        acc = torch.zeros_like(updates[0][k], dtype=torch.float32)
+        for u, s in zip(updates, sizes):
+            acc += u[k].float() * (s / total)
+        out[k] = acc.to(updates[0][k].dtype)
+    return out
+
+def lie_attack(genuine_models, z):
+    """LIE: per-tensor mean + z*std over the leaked genuine models
+    (reference: create_LIE_state_dict, src/Utils.py:83-98,207-214)."""
+    out = {}
+    for k in genuine_models[0]:
+        stack = torch.stack([g[k].float() for g in genuine_models])
+        out[k] = (stack.mean(0) + z * stack.std(0, unbiased=True)).to(genuine_models[0][k].dtype)
+    return out
+
+
+def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-statistic AUC (equivalent to sklearn roc_curve+auc, the
+    reference's metric, src/Validation.py:116-117)."""
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    r = 1.0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i:j + 1]] = (r + r + (j - i)) / 2.0
+        r += j - i + 1
+        i = j + 1
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    if n_pos * n_neg == 0:
+        return float("nan")
+    return float((ranks[labels > 0.5].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
+        batch_size: int = 128, lr: float = 0.004, clip: float = 1.0,
+        num_data_range=(12000, 15000), train_size: int = 20000,
+        test_size: int = 4000, genuine_rate: float = 0.5, seed: int = 1,
+        attackers: int = 0, lie_z: float = 0.74) -> dict:
+    """Run the reference FL algorithm in torch on the shared synthetic data.
+
+    config_id 1 = CNNModel FedAvg no attack; 4 = TransformerModel FedAvg
+    with LIE attackers (BASELINE.json configs).
+    """
+    torch.manual_seed(seed)
+    random.seed(seed)
+    rng = np.random.default_rng(seed)
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+
+    train = make_dataset("ICU", train_size, seed=seed)
+    test = make_dataset("ICU", test_size, seed=seed + 10_000)
+    model = TorchCNN() if config_id == 1 else TorchTransformer()
+    global_sd = {k: v.clone() for k, v in model.state_dict().items()}
+
+    attacker_ids = set(range(clients - attackers, clients))
+    lo, hi = num_data_range
+    prev_genuine: list[dict] = []
+    auc = float("nan")
+    t0 = time.perf_counter()
+    for rnd in range(1, rounds + 1):
+        updates, sizes = [], []
+        new_genuine = []
+        for cid in range(clients):
+            num_data = rng.integers(lo, hi + 1)
+            idx = rng.choice(train_size, size=min(num_data, train_size), replace=False)
+            if cid in attacker_ids and prev_genuine:
+                k = max(int(genuine_rate * len(prev_genuine)), 1)
+                sample = [prev_genuine[i] for i in
+                          rng.choice(len(prev_genuine), size=k, replace=False)]
+                upd = lie_attack(copy.deepcopy(sample), lie_z)
+            else:
+                upd = train_local(model, global_sd, train, idx, epochs=epochs,
+                                  batch_size=batch_size, lr=lr, clip=clip)
+                if upd is None:  # NaN round: reference retries; we just skip
+                    continue
+                if cid not in attacker_ids:
+                    new_genuine.append(upd)
+            updates.append(upd)
+            sizes.append(len(idx))
+        if new_genuine:
+            prev_genuine = new_genuine
+        global_sd = fedavg(updates, sizes)
+
+        model.load_state_dict(global_sd)
+        model.eval()
+        with torch.no_grad():
+            probs = model(torch.from_numpy(test["vitals"]),
+                          torch.from_numpy(test["labs"]))[:, 0].numpy()
+        auc = roc_auc(test["label"], probs)
+    elapsed = time.perf_counter() - t0
+    return {
+        "config": config_id,
+        "clients": clients,
+        "rounds": rounds,
+        "final_roc_auc": auc,
+        "rounds_per_sec": rounds / elapsed,
+        "seconds": elapsed,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", type=int, default=1, choices=(1, 4))
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--train-size", type=int, default=20000)
+    ap.add_argument("--test-size", type=int, default=4000)
+    ap.add_argument("--num-data", type=int, nargs=2, default=None)
+    args = ap.parse_args()
+    clients = args.clients if args.clients is not None else (3 if args.config == 1 else 100)
+    attackers = 0 if args.config == 1 else max(clients // 4, 1)
+    ndr = tuple(args.num_data) if args.num_data else (12000, 15000)
+    out = run(args.config, clients=clients, rounds=args.rounds,
+              epochs=args.epochs, train_size=args.train_size,
+              test_size=args.test_size, num_data_range=ndr,
+              attackers=attackers)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
