@@ -14,6 +14,10 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the suite is compile-bound (tiny shapes,
+# many distinct programs), so repeat runs drop from minutes to seconds.
+jax.config.update("jax_compilation_cache_dir", "/tmp/attackfl_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

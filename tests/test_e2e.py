@@ -74,6 +74,7 @@ def test_non_iid_partition_runs():
     assert all(h["ok"] for h in hist)
 
 
+@pytest.mark.slow
 def test_min_max_attack_with_defense_modes():
     atk = (AttackSpec(mode="Min-Max", num_clients=1, attack_round=2),)
     for mode in ("krum", "shieldfl"):

@@ -28,6 +28,7 @@ BASE = dict(
 
 
 @pytest.mark.parametrize("mode", ["fedavg", "hyper"])
+@pytest.mark.slow
 def test_fused_matches_per_round(mode, tmp_path):
     cfg = Config(mode=mode, log_path=str(tmp_path), **BASE)
     sim = Simulator(cfg)
@@ -47,6 +48,7 @@ def test_fused_rejects_host_side_modes(tmp_path):
         sim.run_scan(sim.init_state(), 2)
 
 
+@pytest.mark.slow
 def test_fused_chunking_and_counters(tmp_path):
     cfg = Config(mode="fedavg", log_path=str(tmp_path), **BASE)
     sim = Simulator(cfg)
