@@ -124,11 +124,28 @@ def server_main(argv=None) -> None:
     parser.add_argument("--no-wait", action="store_true",
                         help="skip client rendezvous; attackers come from config")
     parser.add_argument("--rounds", type=int, default=None, help="override num-round")
+    # --- multi-host (DCN) scale-out: one process per host, same command
+    # with a distinct --process-id (parallel/mesh.distributed_init) ---
+    parser.add_argument("--coordinator", type=str, default=None,
+                        help="host:port of process 0; enables jax.distributed")
+    parser.add_argument("--num-processes", type=int, default=1)
+    parser.add_argument("--process-id", type=int, default=0)
     args = parser.parse_args(argv)
 
     if args.device:
         import jax
         jax.config.update("jax_platforms", args.device)
+
+    if args.coordinator:
+        if not args.no_wait:
+            # the file rendezvous is host-local; with N hosts the attacker
+            # assignment must come from the shared config so every process
+            # builds the identical SPMD program
+            print("Error: --coordinator requires --no-wait "
+                  "(declare attackers in config's attack-clients).")
+            sys.exit(1)
+        from attackfl_tpu.parallel.mesh import distributed_init
+        distributed_init(args.coordinator, args.num_processes, args.process_id)
 
     cfg = load_config(args.config)
     base = os.path.dirname(os.path.abspath(args.config))

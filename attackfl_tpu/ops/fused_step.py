@@ -2,16 +2,30 @@
 kernel.
 
 The framework's hot loop is the reference's client SGD loop
-(client.py:80-107) vmapped over clients: per minibatch, forward + backward
-+ grad-clip + Adam.  Under XLA that is ~150 small kernels per step, each
-~5-10us latency-bound — the step cost is kernel COUNT, not FLOPs
-(profiled: 585 steps x ~1.1ms at 100 clients on one chip).  This module
-hand-fuses the entire step for the flagship ICU TransformerModel into a
-single Pallas program: grid (client-chunks, minibatches), each step
-computing forward, hand-derived backward, global-norm clip and Adam for G
-clients' [B, 23] batches, with params/m/v blocks RESIDENT in VMEM across
-the minibatch grid axis (index map invariant along it) so HBM sees each
-chunk's state once per epoch.
+(/root/reference/client.py:80-107) vmapped over clients: per minibatch,
+forward + backward + grad-clip + Adam.  Under XLA that is ~150 small
+kernels per step, each ~5-10us latency-bound — the step cost is kernel
+COUNT, not FLOPs (profiled: 585 steps x ~1.1ms at 100 clients on one
+chip).  This module hand-fuses the entire step for the flagship ICU
+TransformerModel into a single Pallas program: grid (client-chunks,
+minibatches), each step computing forward, hand-derived backward, global-
+norm clip and Adam for G clients' [B, 23] batches, with params/m/v blocks
+RESIDENT in VMEM across the minibatch grid axis (index map invariant along
+it) so HBM sees each chunk's state once per epoch.
+
+Mosaic-lowering constraints shape the implementation (discovered on real
+TPU hardware; the interpret path accepts much more than Mosaic does):
+* no gathers/scatters: every parameter row access is a static slice
+  (``vecs[:, i:i+1, :w]``), and gradients are assembled with keepdims
+  reductions + ``concatenate`` instead of ``.at[].set``;
+* no rank-changing reshapes on the lane dim: the scalar loss/logit chain
+  stays in ``[G, B, 1]`` space end-to-end;
+* no lane-dim slicing of the input: instead of slicing vitals/labs
+  columns out of the batch, the input projections are stored as padded
+  [32, D] matrices whose rows sit at the data-column offsets
+  (``IN_OFFS``), so ``z1 = batch @ W_ext`` runs on the MXU directly; the
+  weight rows outside each branch's span are zero and their gradients are
+  masked, keeping them inert under Adam.
 
 Exactness:
 * attention uses the seq-len-1 identity (models/layers.Seq1Attention):
@@ -21,8 +35,9 @@ Exactness:
 * LayerNorm eps 1e-6 (flax), Adam b1 .9 / b2 .999 / eps 1e-8 with bias
   correction, clip-by-global-norm across ALL leaves — matching optax
   (`clip_by_global_norm` then `adam`, training/local.make_optimizer);
-* dropout masks come from the TPU hardware PRNG with torch-style
-  elementwise semantics (a different stream than the JAX path — same
+* dropout masks come from the TPU hardware PRNG with elementwise
+  inverted-dropout semantics (a different stream than the JAX path, and
+  elementwise rather than per-head on the attention value — same rate and
   distribution; parity is metric-level, SURVEY.md §7).
 
 With dropout rates forced to 0 the kernel is deterministic and is tested
@@ -47,6 +62,7 @@ from jax.experimental.pallas import tpu as pltpu
 D = 64          # model width
 FF = 8          # ffn dim 6, padded to 8 (pad cols/rows stay zero)
 NV = 26         # [64]-vector slots in `vecs`
+NIN = 32        # padded input-projection rows (data block has 32 columns)
 B1, B2, EPS = 0.9, 0.999, 1e-8
 LN_EPS = 1e-6
 _GELU_C = math.sqrt(2.0 / math.pi)
@@ -57,6 +73,8 @@ S_BF1, S_BF2, S_WOUT, S_BOUT = 22, 23, 24, 25
 
 BRANCHES = ("vitals", "labs")
 IN_DIMS = (7, 16)
+IN_OFFS = (0, 7)   # column offsets of each branch's features in the batch
+COL_LABEL, COL_MASK = 23, 24
 GROUP_ORDER = ("w_in", "w_sq", "w_ff1", "w_ff2", "w_h1", "w_h2", "vecs")
 N_G = len(GROUP_ORDER)
 
@@ -66,20 +84,26 @@ N_G = len(GROUP_ORDER)
 # ---------------------------------------------------------------------------
 
 def pack_params(stacked: Any) -> dict[str, jnp.ndarray]:
-    """Stacked TransformerModel params [C, ...] -> packed dense groups."""
+    """Stacked TransformerModel params [C, ...] -> packed dense groups.
+
+    ``w_in`` slot b is a [NIN, D] matrix whose rows IN_OFFS[b] ..
+    IN_OFFS[b]+IN_DIMS[b] hold the branch's input kernel and every other
+    row is zero, so the kernel can project the full 32-column batch block
+    without lane slicing.
+    """
     p = stacked
     C = p["fc1"]["kernel"].shape[0]
     f32 = jnp.float32
 
-    w_in = jnp.zeros((C, 2, 16, D), f32)
+    w_in = jnp.zeros((C, 2, NIN, D), f32)
     w_sq = jnp.zeros((C, 4, D, D), f32)
     w_ff1 = jnp.zeros((C, 2, D, FF), f32)
     w_ff2 = jnp.zeros((C, 2, FF, D), f32)
     vecs = jnp.zeros((C, NV, D), f32)
 
-    for b, (name, f) in enumerate(zip(BRANCHES, IN_DIMS)):
+    for b, (name, f, off) in enumerate(zip(BRANCHES, IN_DIMS, IN_OFFS)):
         blk = p[f"{name}_transformer"]
-        w_in = w_in.at[:, b, :f, :].set(p[f"{name}_dense"]["kernel"])
+        w_in = w_in.at[:, b, off:off + f, :].set(p[f"{name}_dense"]["kernel"])
         w_sq = w_sq.at[:, 2 * b].set(blk["attention"]["value"]["kernel"].reshape(C, D, D))
         w_sq = w_sq.at[:, 2 * b + 1].set(blk["attention"]["out"]["kernel"].reshape(C, D, D))
         w_ff1 = w_ff1.at[:, b, :, :6].set(blk["ffn_dense1"]["kernel"])
@@ -117,10 +141,10 @@ def unpack_params(groups: dict[str, jnp.ndarray], template: Any) -> Any:
     out = jax.tree.map(lambda x: x, template)  # fresh nested dicts
     vecs = groups["vecs"]
 
-    for b, (name, f) in enumerate(zip(BRANCHES, IN_DIMS)):
+    for b, (name, f, off) in enumerate(zip(BRANCHES, IN_DIMS, IN_OFFS)):
         base = 11 * b
         blk = out[f"{name}_transformer"]
-        out[f"{name}_dense"]["kernel"] = groups["w_in"][:, b, :f, :]
+        out[f"{name}_dense"]["kernel"] = groups["w_in"][:, b, off:off + f, :]
         out[f"{name}_dense"]["bias"] = vecs[:, base + S_BD]
         blk["attention"]["value"]["kernel"] = groups["w_sq"][:, 2 * b].reshape(C, D, 4, 16)
         blk["attention"]["value"]["bias"] = vecs[:, base + S_BV].reshape(C, 4, 16)
@@ -169,9 +193,11 @@ def _ln_fwd(r, g, b):
 
 
 def _ln_bwd(dy, xhat, rstd, g):
+    """dg/db come back as [G, 1, D] rows (keepdims — Mosaic-friendly:
+    no rank-changing reshape when assembling the vecs gradient)."""
     dyg = dy * g
-    dg = jnp.sum(dy * xhat, axis=-2)
-    db = jnp.sum(dy, axis=-2)
+    dg = jnp.sum(dy * xhat, axis=-2, keepdims=True)
+    db = jnp.sum(dy, axis=-2, keepdims=True)
     dx = (dyg - jnp.mean(dyg, axis=-1, keepdims=True)
           - xhat * jnp.mean(dyg * xhat, axis=-1, keepdims=True)) * rstd
     return dx, dg, db
@@ -196,10 +222,37 @@ def _bmm_dx(dz, w):
 
 
 def _mask(shape, rate):
-    """Torch-style elementwise inverted-dropout mask from the HW PRNG."""
+    """Elementwise inverted-dropout mask from the TPU hardware PRNG."""
     bits = pltpu.prng_random_bits(shape)
     thr = np.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
     return jnp.where(bits >= thr, np.float32(1.0 / (1.0 - rate)), np.float32(0.0))
+
+
+def _sl(x, i):
+    """x[:, i] for static i without a gather: unit slice + squeeze (the
+    squeeze only drops a unit middle dim — minor layout unchanged)."""
+    return jnp.squeeze(x[:, i:i + 1], axis=1)
+
+
+def _row(vecs, i, w=D):
+    """vecs[:, i] as a broadcastable [G, 1, w] row without a gather."""
+    return vecs[:, i:i + 1, :w]
+
+
+def _pad_row(x):
+    """[G, 1, w] -> [G, 1, D] by zero-extending the lane dim."""
+    w = x.shape[-1]
+    if w == D:
+        return x
+    return jnp.concatenate(
+        [x, jnp.zeros((x.shape[0], 1, D - w), jnp.float32)], axis=-1)
+
+
+def _col(data, c):
+    """Column c of the [G, B, 32] batch as [G, B, 1] (iota-select +
+    reduce; integer indexing would be an unsupported 3D gather)."""
+    sel = jax.lax.broadcasted_iota(jnp.int32, data.shape, 2) == c
+    return jnp.sum(jnp.where(sel, data, 0.0), axis=-1, keepdims=True)
 
 
 # ---------------------------------------------------------------------------
@@ -230,8 +283,8 @@ def _train_step_kernel(sc_ref, *refs, lr, clip, drop_attn, drop_block,
 
     pd = {k: ref[...] for k, ref in zip(GROUP_ORDER, p_out)}
     data = batch_ref[...].reshape(G, B, 32)
-    x0v, x0l = data[:, :, 0:7], data[:, :, 7:23]
-    y, msk = data[:, :, 23], data[:, :, 24]
+    y = _col(data, COL_LABEL)                                 # [G,B,1]
+    msk = _col(data, COL_MASK)                                # [G,B,1]
 
     if dropout:
         pltpu.prng_seed(sc_ref[0] + (sc_ref[1] + j) * 7919 + i * 104729)
@@ -243,142 +296,157 @@ def _train_step_kernel(sc_ref, *refs, lr, clip, drop_attn, drop_block,
     stash, xb = [], []
     for b in range(2):
         base = 11 * b
-        x0 = x0v if b == 0 else x0l
-        f = IN_DIMS[b]
-        z1 = _bmm(x0, pd["w_in"][:, b, :f, :]) + vecs[:, None, base + S_BD]
+        # full-width input projection: rows outside this branch's span are
+        # zero, so label/mask columns contribute nothing (see pack_params)
+        z1 = _bmm(data, _sl(pd["w_in"], b)) + _row(vecs, base + S_BD)
         x1 = _gelu(z1)
-        v_ = _bmm(x1, pd["w_sq"][:, 2 * b]) + vecs[:, None, base + S_BV]
-        if drop_attn > 0.0:
-            mh = _mask((G, B, 4), drop_attn)   # one draw per (client,sample,head)
-            mw = jnp.broadcast_to(mh[..., None], (G, B, 4, 16)).reshape(G, B, D)
-        else:
-            mw = ones((G, B, D))
+        v_ = _bmm(x1, _sl(pd["w_sq"], 2 * b)) + _row(vecs, base + S_BV)
+        mw = _mask((G, B, D), drop_attn) if drop_attn > 0.0 else ones((G, B, D))
         vd = v_ * mw
-        a = _bmm(vd, pd["w_sq"][:, 2 * b + 1]) + vecs[:, None, base + S_BO]
+        a = _bmm(vd, _sl(pd["w_sq"], 2 * b + 1)) + _row(vecs, base + S_BO)
         m1 = _mask((G, B, D), drop_block) if drop_block > 0.0 else ones((G, B, D))
         r1 = x1 + a * m1
-        g1 = vecs[:, None, base + S_G1]
-        x2, xhat1, rstd1 = _ln_fwd(r1, g1, vecs[:, None, base + S_BE1])
-        z2 = _bmm(x2, pd["w_ff1"][:, b]) + vecs[:, None, base + S_B1F, :FF]
+        g1 = _row(vecs, base + S_G1)
+        x2, xhat1, rstd1 = _ln_fwd(r1, g1, _row(vecs, base + S_BE1))
+        z2 = _bmm(x2, _sl(pd["w_ff1"], b)) + _row(vecs, base + S_B1F, FF)
         h = _gelu(z2)
         mf = _mask((G, B, FF), drop_block) if drop_block > 0.0 else ones((G, B, FF))
         hd = h * mf
-        yf = _bmm(hd, pd["w_ff2"][:, b]) + vecs[:, None, base + S_B2F]
+        yf = _bmm(hd, _sl(pd["w_ff2"], b)) + _row(vecs, base + S_B2F)
         m2 = _mask((G, B, D), drop_block) if drop_block > 0.0 else ones((G, B, D))
         r2 = x2 + yf * m2
-        g2 = vecs[:, None, base + S_G2]
-        x3, xhat2, rstd2 = _ln_fwd(r2, g2, vecs[:, None, base + S_BE2])
-        g3 = vecs[:, None, base + S_G3]
-        xb_b, xhat3, rstd3 = _ln_fwd(x3, g3, vecs[:, None, base + S_BE3])
+        g2 = _row(vecs, base + S_G2)
+        x3, xhat2, rstd2 = _ln_fwd(r2, g2, _row(vecs, base + S_BE2))
+        g3 = _row(vecs, base + S_G3)
+        xb_b, xhat3, rstd3 = _ln_fwd(x3, g3, _row(vecs, base + S_BE3))
         xb.append(xb_b)
-        stash.append((x0, z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
+        stash.append((z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
                       hd, m2, xhat2, rstd2, g2, xhat3, rstd3, g3))
 
     cc = jnp.concatenate(xb, axis=-1)                         # [G,B,128]
-    z4 = _bmm(cc, pd["w_h1"]) + vecs[:, None, S_BF1]
+    z4 = _bmm(cc, pd["w_h1"]) + _row(vecs, S_BF1)
     x4 = _gelu(z4)
     m4 = _mask((G, B, D), drop_head) if drop_head > 0.0 else ones((G, B, D))
     x4d = x4 * m4
-    z5 = _bmm(x4d, pd["w_h2"]) + vecs[:, None, S_BF2, :32]
+    z5 = _bmm(x4d, pd["w_h2"]) + _row(vecs, S_BF2, 32)
     x5 = _gelu(z5)                                            # [G,B,32]
-    w_out = vecs[:, S_WOUT, :32]
-    z6 = jnp.sum(x5 * w_out[:, None, :], axis=-1) + vecs[:, None, S_BOUT, 0]
-    prob = jax.nn.sigmoid(z6)                                 # [G,B]
+    wo = _row(vecs, S_WOUT, 32)                               # [G,1,32]
+    z6 = (jnp.sum(x5 * wo, axis=-1, keepdims=True)
+          + _row(vecs, S_BOUT, 1))                            # [G,B,1]
+    prob = jax.nn.sigmoid(z6)                                 # [G,B,1]
     lo, hi = np.float32(1e-7), np.float32(1.0 - 1e-7)
     pc = jnp.clip(prob, lo, hi)
 
-    msum = jnp.maximum(jnp.sum(msk, axis=-1), 1.0)            # [G]
+    msum = jnp.maximum(jnp.sum(msk, axis=1, keepdims=True), 1.0)  # [G,1,1]
     per = -(y * jnp.log(pc) + (1.0 - y) * jnp.log(1.0 - pc))
-    loss_step = jnp.sum(per * msk, axis=-1) / msum            # [G]
-    # accumulate into column 0 of the resident (G, 128) loss block — a
-    # dynamic-column store crashes the Mosaic compiler, so the per-step
-    # losses are summed (NaN propagates, preserving the tripwire) and the
-    # host divides by nb for the epoch mean
-    col0 = jax.lax.broadcasted_iota(jnp.int32, loss_ref.shape, 1) == 0
-    loss_ref[...] = loss_ref[...] + jnp.where(col0, loss_step[:, None], 0.0)
+    loss_step = jnp.sum(per * msk, axis=1, keepdims=True) / msum  # [G,1,1]
+    # accumulate into column 0 of the resident (G, 1, 128) loss block (a
+    # dynamic-column store crashes the Mosaic compiler): per-step losses
+    # are summed (NaN propagates, preserving the tripwire) and the host
+    # divides by nb for the epoch mean.  The block is 3D so every
+    # per-client scalar stays [G, 1, 1] — a [G, 1] layout (sublane=G,
+    # lane=1) hard-crashes the Mosaic layout engine.
+    col0 = jax.lax.broadcasted_iota(jnp.int32, loss_ref.shape, 2) == 0
+    loss_ref[...] = loss_ref[...] + jnp.where(col0, loss_step, 0.0)
 
     # ---------------- backward ----------------
     within = ((prob > lo) & (prob < hi)).astype(jnp.float32)
-    dpc = msk * (pc - y) / (pc * (1.0 - pc)) / msum[:, None]
-    dz6 = dpc * within * prob * (1.0 - prob)                  # [G,B]
-    g_wout = jnp.sum(x5 * dz6[..., None], axis=1)             # [G,32]
-    g_bout = jnp.sum(dz6, axis=1)                             # [G]
-    dx5 = dz6[..., None] * w_out[:, None, :]
+    dpc = msk * (pc - y) / (pc * (1.0 - pc)) / msum
+    dz6 = dpc * within * prob * (1.0 - prob)                  # [G,B,1]
+    g_wout = jnp.sum(x5 * dz6, axis=1, keepdims=True)         # [G,1,32]
+    g_bout = jnp.sum(dz6, axis=1, keepdims=True)              # [G,1,1]
+    dx5 = dz6 * wo                                            # [G,B,32]
     dz5 = dx5 * _gelu_grad(z5)
     g_wh2 = _bmm_dw(x4d, dz5)
-    g_bf2 = jnp.sum(dz5, axis=1)
+    g_bf2 = jnp.sum(dz5, axis=1, keepdims=True)               # [G,1,32]
     dx4 = _bmm_dx(dz5, pd["w_h2"]) * m4
     dz4 = dx4 * _gelu_grad(z4)
     g_wh1 = _bmm_dw(cc, dz4)
-    g_bf1 = jnp.sum(dz4, axis=1)
+    g_bf1 = jnp.sum(dz4, axis=1, keepdims=True)               # [G,1,D]
     dcc = _bmm_dx(dz4, pd["w_h1"])
 
-    g_win = jnp.zeros((G, 2, 16, D), jnp.float32)
-    g_wsq = jnp.zeros((G, 4, D, D), jnp.float32)
-    g_wff1 = jnp.zeros((G, 2, D, FF), jnp.float32)
-    g_wff2 = jnp.zeros((G, 2, FF, D), jnp.float32)
-    g_vecs = jnp.zeros((G, NV, D), jnp.float32)
+    rows: list = [None] * NV
+    g_win_parts, g_wsq_parts, g_wff1_parts, g_wff2_parts = [], [], [], []
 
     for b in range(2):
         base = 11 * b
-        (x0, z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
+        (z1, x1, mw, vd, m1, xhat1, rstd1, g1, x2, z2, mf,
          hd, m2, xhat2, rstd2, g2, xhat3, rstd3, g3) = stash[b]
         dxb = dcc[:, :, b * D:(b + 1) * D]
         dx3, dg3, db3 = _ln_bwd(dxb, xhat3, rstd3, g3)
         dr2, dg2, db2 = _ln_bwd(dx3, xhat2, rstd2, g2)
         dyf = dr2 * m2
-        g_wff2 = g_wff2.at[:, b].set(_bmm_dw(hd, dyf))
-        db2f = jnp.sum(dyf, axis=1)
-        dz2 = _bmm_dx(dyf, pd["w_ff2"][:, b]) * mf * _gelu_grad(z2)
-        g_wff1 = g_wff1.at[:, b].set(_bmm_dw(x2, dz2))
-        db1f = jnp.sum(dz2, axis=1)                           # [G,FF]
-        dx2 = dr2 + _bmm_dx(dz2, pd["w_ff1"][:, b])
+        g_wff2_parts.append(_bmm_dw(hd, dyf))
+        db2f = jnp.sum(dyf, axis=1, keepdims=True)            # [G,1,D]
+        dz2 = _bmm_dx(dyf, _sl(pd["w_ff2"], b)) * mf * _gelu_grad(z2)
+        g_wff1_parts.append(_bmm_dw(x2, dz2))
+        db1f = jnp.sum(dz2, axis=1, keepdims=True)            # [G,1,FF]
+        dx2 = dr2 + _bmm_dx(dz2, _sl(pd["w_ff1"], b))
         dr1, dg1, db1 = _ln_bwd(dx2, xhat1, rstd1, g1)
         da = dr1 * m1
-        g_wsq = g_wsq.at[:, 2 * b + 1].set(_bmm_dw(vd, da))
-        dbo = jnp.sum(da, axis=1)
-        dv = _bmm_dx(da, pd["w_sq"][:, 2 * b + 1]) * mw
-        g_wsq = g_wsq.at[:, 2 * b].set(_bmm_dw(x1, dv))
-        dbv = jnp.sum(dv, axis=1)
-        dx1 = dr1 + _bmm_dx(dv, pd["w_sq"][:, 2 * b])
+        g_wsq_o = _bmm_dw(vd, da)
+        dbo = jnp.sum(da, axis=1, keepdims=True)
+        dv = _bmm_dx(da, _sl(pd["w_sq"], 2 * b + 1)) * mw
+        g_wsq_v = _bmm_dw(x1, dv)
+        g_wsq_parts.extend([g_wsq_v, g_wsq_o])
+        dbv = jnp.sum(dv, axis=1, keepdims=True)
+        dx1 = dr1 + _bmm_dx(dv, _sl(pd["w_sq"], 2 * b))
         dz1 = dx1 * _gelu_grad(z1)
-        f = IN_DIMS[b]
-        g_win = g_win.at[:, b, :f, :].set(_bmm_dw(x0, dz1))
-        g_vecs = g_vecs.at[:, base + S_BD].set(jnp.sum(dz1, axis=1))
-        g_vecs = g_vecs.at[:, base + S_BV].set(dbv)
-        g_vecs = g_vecs.at[:, base + S_BO].set(dbo)
-        g_vecs = g_vecs.at[:, base + S_B1F, :FF].set(db1f)
-        g_vecs = g_vecs.at[:, base + S_B2F].set(db2f)
-        g_vecs = g_vecs.at[:, base + S_G1].set(dg1)
-        g_vecs = g_vecs.at[:, base + S_BE1].set(db1)
-        g_vecs = g_vecs.at[:, base + S_G2].set(dg2)
-        g_vecs = g_vecs.at[:, base + S_BE2].set(db2)
-        g_vecs = g_vecs.at[:, base + S_G3].set(dg3)
-        g_vecs = g_vecs.at[:, base + S_BE3].set(db3)
+        # full-width input grad, masked to this branch's row span so the
+        # zero padding rows (incl. label/mask columns) never train
+        g_full = _bmm_dw(data, dz1)                           # [G,32,D]
+        row_id = jax.lax.broadcasted_iota(jnp.int32, g_full.shape, 1)
+        off, f = IN_OFFS[b], IN_DIMS[b]
+        g_win_parts.append(
+            jnp.where((row_id >= off) & (row_id < off + f), g_full, 0.0))
+        rows[base + S_BD] = jnp.sum(dz1, axis=1, keepdims=True)
+        rows[base + S_BV] = dbv
+        rows[base + S_BO] = dbo
+        rows[base + S_B1F] = _pad_row(db1f)
+        rows[base + S_B2F] = db2f
+        rows[base + S_G1] = dg1
+        rows[base + S_BE1] = db1
+        rows[base + S_G2] = dg2
+        rows[base + S_BE2] = db2
+        rows[base + S_G3] = dg3
+        rows[base + S_BE3] = db3
 
-    g_vecs = g_vecs.at[:, S_BF1].set(g_bf1)
-    g_vecs = g_vecs.at[:, S_BF2, :32].set(g_bf2)
-    g_vecs = g_vecs.at[:, S_WOUT, :32].set(g_wout)
-    g_vecs = g_vecs.at[:, S_BOUT, 0].set(g_bout)
+    rows[S_BF1] = g_bf1
+    rows[S_BF2] = _pad_row(g_bf2)
+    rows[S_WOUT] = _pad_row(g_wout)
+    rows[S_BOUT] = _pad_row(g_bout)
+    g_vecs = jnp.concatenate(rows, axis=1)                    # [G,NV,D]
 
-    grads = {"w_in": g_win, "w_sq": g_wsq, "w_ff1": g_wff1, "w_ff2": g_wff2,
+    def _stack1(parts):
+        return jnp.concatenate([p[:, None] for p in parts], axis=1)
+
+    grads = {"w_in": _stack1(g_win_parts), "w_sq": _stack1(g_wsq_parts),
+             "w_ff1": _stack1(g_wff1_parts), "w_ff2": _stack1(g_wff2_parts),
              "w_h1": g_wh1, "w_h2": g_wh2, "vecs": g_vecs}
 
     # ---------------- clip + Adam ----------------
+    # every per-client scalar lives in [G,1,1] — see the loss-block note
     if clip > 0.0:
-        gn2 = jnp.zeros((G,), jnp.float32)
+        gn2 = jnp.zeros((G, 1, 1), jnp.float32)
         for k in GROUP_ORDER:
             g = grads[k]
-            gn2 = gn2 + jnp.sum(g * g, axis=tuple(range(1, g.ndim)))
+            # one axis at a time — Mosaic rejects multi-trailing-dim reduces
+            s = jnp.sum(g * g, axis=-1, keepdims=True)
+            s = jnp.sum(s, axis=-2, keepdims=True)
+            if g.ndim == 4:
+                s = jnp.sum(s, axis=1)                        # [G,1,1]
+            gn2 = gn2 + s
         scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(gn2), 1e-12))
     else:
-        scale = jnp.ones((G,), jnp.float32)
+        scale = jnp.ones((G, 1, 1), jnp.float32)
+    scale4 = scale[:, None]                                   # [G,1,1,1]
 
+    # bias correction via exp/log — Mosaic has no powf lowering
     t = (sc_ref[1] + j + 1).astype(jnp.float32)
-    bc1 = 1.0 - B1 ** t
-    bc2 = 1.0 - B2 ** t
+    bc1 = 1.0 - jnp.exp(t * np.float32(math.log(B1)))
+    bc2 = 1.0 - jnp.exp(t * np.float32(math.log(B2)))
     for k, mp, vp, pp in zip(GROUP_ORDER, m_out, v_out, p_out):
-        g = grads[k] * scale.reshape((G,) + (1,) * (grads[k].ndim - 1))
+        g = grads[k] * (scale4 if grads[k].ndim == 4 else scale)
         m_new = B1 * mp[...] + (1.0 - B1) * g
         v_new = B2 * vp[...] + (1.0 - B2) * (g * g)
         mp[...] = m_new
@@ -420,10 +488,10 @@ def run_epoch(groups_p, groups_m, groups_v, batches, seed, t_offset, *,
     state_specs = [gspec(a) for a in p_list + m_list + v_list]
     batch_spec = pl.BlockSpec((G, 1, B, 32), lambda i, j, sc: (i, j, 0, 0),
                               memory_space=pltpu.VMEM)
-    loss_spec = pl.BlockSpec((G, 128), lambda i, j, sc: (i, 0),
+    loss_spec = pl.BlockSpec((G, 1, 128), lambda i, j, sc: (i, 0, 0),
                              memory_space=pltpu.VMEM)
 
-    out_shapes = ([jax.ShapeDtypeStruct((C_pad, 128), jnp.float32)]
+    out_shapes = ([jax.ShapeDtypeStruct((C_pad, 1, 128), jnp.float32)]
                   + [jax.ShapeDtypeStruct(a.shape, a.dtype)
                      for a in p_list + m_list + v_list])
     out_specs = [loss_spec] + state_specs
@@ -452,7 +520,7 @@ def run_epoch(groups_p, groups_m, groups_v, batches, seed, t_offset, *,
         interpret=interpret,
     )(sc, *p_list, *m_list, *v_list, batches)
 
-    loss_sums = outs[0][:, 0]
+    loss_sums = outs[0][:, 0, 0]
     new_p = dict(zip(GROUP_ORDER, outs[1:1 + N_G]))
     new_m = dict(zip(GROUP_ORDER, outs[1 + N_G:1 + 2 * N_G]))
     new_v = dict(zip(GROUP_ORDER, outs[1 + 2 * N_G:1 + 3 * N_G]))

@@ -25,8 +25,66 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def distributed_init(coordinator: str, num_processes: int, process_id: int) -> None:
+    """Join the JAX distributed runtime: the DCN scale-out entry point.
+
+    This is the TPU-native analog of the reference's multi-machine
+    deployment story — one RabbitMQ broker plus server/client processes on
+    different hosts (/root/reference/README.md:91-143).  Here every host
+    runs the SAME SPMD program: after this call ``jax.devices()`` contains
+    every process's devices, :func:`make_client_mesh` spans them all, and
+    the aggregation collectives ride ICI within a host and DCN between
+    hosts — no broker, no pickle, no explicit send/recv anywhere.
+
+    Call before any other JAX API (backend init is process-global).
+    Typical invocation, one per host (see README "Multi-host"):
+
+        python server.py --no-wait --coordinator HOST0:1234 \\
+            --num-processes 2 --process-id {0,1}
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_multiprocess(mesh: Mesh | None) -> bool:
+    """True when ``mesh`` spans devices from more than one process (a DCN
+    mesh) — host-side code must then avoid materializing sharded arrays."""
+    if mesh is None:
+        return False
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def replicate_to_mesh(tree: Any, mesh: Mesh) -> Any:
+    """Replicate host-local values onto every device of a (possibly
+    multi-process) mesh, so they can feed a global SPMD program.  Every
+    process must hold the same values (same seed => same init).
+
+    Uses ``make_array_from_callback`` — ``device_put`` refuses shardings
+    with non-addressable (remote) devices.  Typed PRNG keys are unwrapped
+    to their raw uint32 data and re-wrapped (numpy can't see key arrays).
+    """
+    sharding = NamedSharding(mesh, P())
+
+    def put(x):
+        if not hasattr(x, "shape"):
+            return x
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(x)
+            data = np.asarray(jax.random.key_data(x))
+            g = jax.make_array_from_callback(data.shape, sharding, lambda i: data[i])
+            return jax.random.wrap_key_data(g, impl=impl)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, sharding, lambda i: arr[i])
+
+    return jax.tree.map(put, tree)
+
+
 def make_client_mesh(num_devices: int = 0, axis_name: str = "clients") -> Mesh:
-    """1-D mesh over ``num_devices`` (0 = all visible devices)."""
+    """1-D mesh over ``num_devices`` (0 = all visible devices, including
+    every remote process's devices after :func:`distributed_init`)."""
     devices = jax.devices()
     if num_devices and num_devices > 0:
         devices = devices[:num_devices]

@@ -30,7 +30,9 @@ from attackfl_tpu.eval.validation import Validation
 from attackfl_tpu.models.hyper import make_hypernetwork
 from attackfl_tpu.ops import defenses
 from attackfl_tpu.ops import pytree as pt
-from attackfl_tpu.parallel.mesh import make_client_mesh, make_constrain
+from attackfl_tpu.parallel.mesh import (
+    is_multiprocess, make_client_mesh, make_constrain, replicate_to_mesh,
+)
 from attackfl_tpu.registry import get_model
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
 from attackfl_tpu.training.round import build_aggregator, build_attack_groups, build_round_step
@@ -94,10 +96,28 @@ class Simulator:
         if use_mesh and mesh is None:
             self.mesh = make_client_mesh(cfg.mesh.num_devices, cfg.mesh.axis_name)
         if self.mesh is not None and cfg.total_clients % self.mesh.size != 0:
+            if is_multiprocess(self.mesh):
+                # dropping to mesh=None here would silently run N identical
+                # full simulations, one per host — refuse instead
+                raise ValueError(
+                    f"{cfg.total_clients} clients must divide the "
+                    f"{self.mesh.size}-device multi-host mesh"
+                )
             print_with_color(
                 f"[mesh] {cfg.total_clients} clients not divisible by "
                 f"{self.mesh.size} devices; running replicated.", "yellow")
             self.mesh = None
+        # Multi-host (DCN) mesh: every process runs this same Simulator
+        # SPMD (parallel/mesh.distributed_init).  Host-side code must not
+        # materialize sharded arrays, and checkpoints are disabled (a
+        # host-local msgpack of a DCN-sharded tree would need a gather).
+        self.multiprocess = is_multiprocess(self.mesh)
+        if self.multiprocess and cfg.mode in ("gmm", "fltracer"):
+            raise ValueError(
+                f"mode '{cfg.mode}' filters on host with sklearn-style "
+                "stats and needs the full client matrix locally; run it "
+                "single-process (the matrices are tiny — SURVEY.md §7)"
+            )
         if cfg.local_backend == "pallas" and self.mesh is not None:
             raise ValueError(
                 "local_backend 'pallas' is the single-chip fused fast path; "
@@ -200,6 +220,11 @@ class Simulator:
                 "completed_rounds": np.asarray(0),
                 "broadcasts": np.asarray(0),
             }
+        if self.multiprocess:
+            # committed-to-local-device arrays can't feed a program over a
+            # multi-process mesh: replicate them globally (every process
+            # computed identical values from the shared seed)
+            state = replicate_to_mesh(state, self.mesh)
         return state
 
     def load_or_init_state(self) -> dict[str, Any]:
@@ -207,6 +232,14 @@ class Simulator:
         (reference: server.py:144-163,578-586)."""
         state = self.init_state()
         if self.cfg.load_parameters:
+            if self.multiprocess:
+                # checkpoints are host-local files; resuming from them on N
+                # hosts with potentially different contents would desync
+                # the SPMD round programs (saving is likewise disabled)
+                print_with_color(
+                    "[mesh] multi-process run: ignoring parameters.load "
+                    "(checkpoints are host-local)", "yellow")
+                return state
             path = ckpt.checkpoint_path(self.cfg)
             try:
                 state = ckpt.load_state(path, state)
@@ -218,6 +251,14 @@ class Simulator:
     # ------------------------------------------------------------------
     # one round
     # ------------------------------------------------------------------
+
+    def _checkpoints_allowed(self, requested: bool) -> bool:
+        """Single chokepoint for the multi-process checkpoint rule."""
+        if requested and self.multiprocess:
+            print_with_color("[mesh] multi-process run: checkpoints off "
+                             "(state is DCN-sharded)", "yellow")
+            return False
+        return requested
 
     def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
         """Execute one broadcast->train->attack->aggregate->validate round.
@@ -550,6 +591,7 @@ class Simulator:
         history: list[dict[str, Any]] = []
         consecutive_failures = 0  # run()'s retry counter semantics
         first_dispatch = True
+        save_checkpoints = self._checkpoints_allowed(save_checkpoints)
 
         while int(state["completed_rounds"]) < num_rounds:
             remaining = num_rounds - int(state["completed_rounds"])
@@ -612,6 +654,7 @@ class Simulator:
         state = state if state is not None else self.load_or_init_state()
         history: list[dict[str, Any]] = []
         retries = 0
+        save_checkpoints = self._checkpoints_allowed(save_checkpoints)
         self.logger.log_info("### Application start ###")
 
         while int(state["completed_rounds"]) < num_rounds:

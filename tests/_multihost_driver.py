@@ -1,0 +1,65 @@
+"""Subprocess driver for the two-process DCN smoke test.
+
+Each invocation is one "host": it joins the JAX distributed runtime over
+localhost (the CPU stand-in for DCN — /root/reference/README.md:91-143 is
+the topology being replaced: broker + one process per machine), builds a
+client mesh spanning BOTH processes' virtual CPU devices, and runs one
+full federated round SPMD.  Run by tests/test_multihost.py.
+
+Usage: python _multihost_driver.py <coordinator> <num_processes> <pid>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    .replace("--xla_force_host_platform_device_count=8", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    coordinator, num_processes, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from attackfl_tpu.parallel.mesh import distributed_init, make_client_mesh
+
+    distributed_init(coordinator, num_processes, pid)
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert len(jax.devices()) == 4 * num_processes, jax.devices()
+
+    from attackfl_tpu.config import AttackSpec, Config
+    from attackfl_tpu.training.engine import Simulator
+
+    mesh = make_client_mesh()
+    cfg = Config(
+        num_round=1,
+        total_clients=16,
+        mode="fedavg",
+        model="TransformerModel",
+        data_name="ICU",
+        num_data_range=(48, 64),
+        epochs=1,
+        batch_size=16,
+        train_size=256,
+        test_size=64,
+        validation=True,
+        genuine_rate=0.5,
+        attacks=(AttackSpec(mode="LIE", num_clients=4, attack_round=1),),
+        log_path=os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost"),
+    )
+    sim = Simulator(cfg, mesh=mesh)
+    assert sim.multiprocess, "mesh should span both processes"
+    state, history = sim.run(save_checkpoints=True, verbose=False)  # auto-disables
+    ok_rounds = sum(1 for h in history if h["ok"])
+    auc = history[-1].get("roc_auc", float("nan"))
+    print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Two-process DCN smoke test: the multi-host scale-out path.
+
+Spawns two OS processes that join one jax.distributed runtime over
+localhost and run the SAME one-round federation SPMD over a mesh spanning
+both processes' virtual CPU devices (4 + 4).  This is the CPU stand-in
+for the reference's only deployment story — broker + one process per
+machine (/root/reference/README.md:91-143) — redesigned as collectives
+over DCN (SURVEY.md §5 "distributed communication backend").
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+DRIVER = os.path.join(os.path.dirname(__file__), "_multihost_driver.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_round(tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {**os.environ, "MULTIHOST_TMP": str(tmp_path)}
+    env.pop("JAX_PLATFORMS", None)  # driver pins cpu itself
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, coordinator, "2", str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"process failed (rc={rc}):\n{out}\n{err[-3000:]}"
+        assert "MULTIHOST_OK" in out, out
+        assert "ok_rounds=1" in out, out
+    # both processes ran the same SPMD program: identical metrics
+    lines = [next(l for l in out.splitlines() if "MULTIHOST_OK" in l)
+             for _, out, _ in outs]
+    auc0 = lines[0].split("roc_auc=")[1]
+    auc1 = lines[1].split("roc_auc=")[1]
+    assert auc0 == auc1, (auc0, auc1)
