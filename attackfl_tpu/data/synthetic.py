@@ -104,9 +104,33 @@ def load_reference_pickle(path: str) -> Batch:
     raise ValueError(f"Unrecognized reference dataset format in {path}")
 
 
+def load_cifar10_batches(root: str, split: str) -> Batch:
+    """Load CIFAR-10 from the standard ``cifar-10-batches-py`` layout the
+    reference pulls via torchvision (root './data', src/Validation.py:38-44):
+    train = data_batch_1..5, test = test_batch, each a pickle dict with
+    ``data`` (N, 3072) uint8 row-major CHW and ``labels``.  Pixels are
+    normalized exactly like the reference's transform —
+    ToTensor (/255) then Normalize(0.5, 0.5) => [-1, 1] — and returned
+    NHWC for the Flax ResNet."""
+    batch_dir = os.path.join(root, "cifar-10-batches-py")
+    names = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    xs, ys = [], []
+    for name in names:
+        with open(os.path.join(batch_dir, name), "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        xs.append(np.asarray(d[b"data"], dtype=np.uint8))
+        ys.append(np.asarray(d[b"labels"], dtype=np.int32))
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x = (x.astype(np.float32) / 255.0 - 0.5) / 0.5
+    return {"x": x, "label": np.concatenate(ys)}
+
+
 def get_dataset(data_name: str, split: str, size: int, seed: int) -> Batch:
-    """Reference-compatible entry point: try the reference's pickle paths
-    first, fall back to synthetic data."""
+    """Reference-compatible entry point: try the reference's on-disk
+    dataset paths first (same working-directory contract as the reference,
+    src/RpcClient.py:155-164 / src/Validation.py:32-44), fall back to
+    synthetic data."""
     paths = {
         ("ICU", "train"): "train_dataset.pkl.gz",
         ("ICU", "test"): "data/test_dataset.pkl.gz",
@@ -116,5 +140,9 @@ def get_dataset(data_name: str, split: str, size: int, seed: int) -> Batch:
     path = paths.get((data_name, split))
     if path and os.path.exists(path):
         return load_reference_pickle(path)
+    if data_name == "CIFAR10" and os.path.exists(
+        os.path.join("data", "cifar-10-batches-py")
+    ):
+        return load_cifar10_batches("data", split)
     # seeds: train/test splits must be disjoint
     return make_dataset(data_name, size, seed=seed + (0 if split == "train" else 10_000))

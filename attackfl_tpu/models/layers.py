@@ -29,16 +29,6 @@ def adaptive_avg_pool1d(x: jnp.ndarray, output_size: int) -> jnp.ndarray:
     return jnp.stack(outs, axis=1)  # (B, output_size, C)
 
 
-def adaptive_max_pool1d(x: jnp.ndarray, output_size: int) -> jnp.ndarray:
-    length = x.shape[1]
-    outs = []
-    for i in range(output_size):
-        start = (i * length) // output_size
-        end = -(-((i + 1) * length) // output_size)
-        outs.append(jnp.max(x[:, start:end, :], axis=1))
-    return jnp.stack(outs, axis=1)
-
-
 class _InertProjection(nn.Module):
     """Declares DenseGeneral-shaped kernel/bias params that take no part in
     the computation (zero-gradient placeholders for tree parity)."""

@@ -8,7 +8,7 @@ that axis, which under pjit sharding compiles to ICI collectives.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -25,29 +25,12 @@ def tree_stack(trees: list[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
-def tree_unstack(tree: Pytree) -> list[Pytree]:
-    """Inverse of :func:`tree_stack`."""
-    leaves, treedef = jax.tree.flatten(tree)
-    n = leaves[0].shape[0]
-    return [jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves]) for i in range(n)]
-
-
 def tree_take(tree: Pytree, idx) -> Pytree:
-    """Index / gather along the leading (client) axis of a stacked tree."""
+    """Index / gather along the leading (client) axis of a stacked tree.
+
+    With a scalar index this is also the inverse of :func:`tree_stack`
+    one client at a time."""
     return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), tree)
-
-
-def tree_select(mask, tree_a: Pytree, tree_b: Pytree) -> Pytree:
-    """Per-client select: ``mask[i] ? tree_a[i] : tree_b[i]``.
-
-    ``mask`` has shape (N,) and broadcasts against each leaf's leading axis.
-    """
-
-    def sel(a, b):
-        m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
-        return jnp.where(m, a, b)
-
-    return jax.tree.map(sel, tree_a, tree_b)
 
 
 def tree_broadcast(tree: Pytree, n: int) -> Pytree:
@@ -78,41 +61,9 @@ def tree_ravel_stacked(stacked: Pytree) -> jnp.ndarray:
     return jnp.concatenate([x.reshape(n, -1) for x in leaves], axis=1)
 
 
-def tree_unravel_like(flat: jnp.ndarray, template: Pytree) -> Pytree:
-    """Reshape a flat (P,) vector back into the structure of ``template``."""
-    leaves, treedef = jax.tree.flatten(template)
-    out, off = [], 0
-    for leaf in leaves:
-        size = leaf.size
-        out.append(flat[off : off + size].reshape(leaf.shape).astype(leaf.dtype))
-        off += size
-    return jax.tree.unflatten(treedef, out)
-
-
-def tree_size(tree: Pytree) -> int:
-    return sum(x.size for x in jax.tree.leaves(tree))
-
-
 # ---------------------------------------------------------------------------
 # norms & distances
 # ---------------------------------------------------------------------------
-
-def tree_l2_norm(tree: Pytree) -> jnp.ndarray:
-    """Global L2 norm over the concatenation of all leaves.
-
-    Matches the reference's FLTrust norm ``sqrt(sum ||p||^2)``
-    (server.py:714,724).
-    """
-    sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(tree))
-    return jnp.sqrt(sq)
-
-
-def tree_cosine(a: Pytree, b: Pytree, eps: float = 1e-12) -> jnp.ndarray:
-    """Cosine similarity of two trees as flat vectors
-    (reference: src/Utils.py:218-222, server.py:682-693)."""
-    dot = sum(jnp.vdot(x, y) for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
-    return dot / (tree_l2_norm(a) * tree_l2_norm(b) + eps)
-
 
 def _leaf_norm(diff: jnp.ndarray, matrix_spectral: bool) -> jnp.ndarray:
     """Per-leaf norm used by :func:`ref_distance`.
@@ -230,12 +181,3 @@ def path_name(path) -> str:
     single definition here.
     """
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-
-
-def tree_map_with_path_names(fn: Callable[[str, jnp.ndarray], jnp.ndarray], tree: Pytree) -> Pytree:
-    """Map with a dotted path name per leaf (registry-style names)."""
-
-    def _fn(path, leaf):
-        return fn(path_name(path), leaf)
-
-    return jax.tree_util.tree_map_with_path(_fn, tree)

@@ -87,6 +87,15 @@ def make_client_mesh(num_devices: int = 0, axis_name: str = "clients") -> Mesh:
     every remote process's devices after :func:`distributed_init`)."""
     devices = jax.devices()
     if num_devices and num_devices > 0:
+        if jax.process_count() > 1:
+            # jax.devices() lists process 0's devices first — truncating
+            # would build a mesh excluding some hosts' devices entirely
+            # (zero addressable shards there).  Multi-host runs span all
+            # devices by construction.
+            raise ValueError(
+                "mesh.num-devices is a single-host knob; multi-host runs "
+                "use every process's devices (set num-devices: 0)"
+            )
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (axis_name,))
 

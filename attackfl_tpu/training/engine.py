@@ -37,9 +37,13 @@ from attackfl_tpu.registry import get_model
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
 from attackfl_tpu.training.round import build_aggregator, build_attack_groups, build_round_step
 from attackfl_tpu.utils import checkpoint as ckpt
-from attackfl_tpu.utils.logging import Logger, print_with_color
+from attackfl_tpu.utils.logging import Logger, RoundTimer, print_with_color
 
 MAX_ROUND_RETRIES = 20
+# run_fast dispatch granularity: one compiled scan of this many rounds
+# (compile time scales with scan length; 16 bounds the first-dispatch
+# compile while amortizing per-dispatch overhead over 16 rounds)
+DEFAULT_SCAN_CHUNK = 16
 
 
 def sample_inputs(data_name: str):
@@ -288,12 +292,14 @@ class Simulator:
 
     def _run_plain_round(self, state, rng, k_round, k_agg, broadcast_number, metrics):
         cfg = self.cfg
-        stacked, sizes, new_genuine, ok, loss = self.round_step(
-            state["global_params"], state["prev_genuine"],
-            jnp.asarray(bool(state["have_genuine"])), k_round,
-            jnp.asarray(broadcast_number),
-        )
-        ok = train_ok = bool(ok)
+        timer = RoundTimer()
+        with timer.phase("train"):
+            stacked, sizes, new_genuine, ok, loss = self.round_step(
+                state["global_params"], state["prev_genuine"],
+                jnp.asarray(bool(state["have_genuine"])), k_round,
+                jnp.asarray(broadcast_number),
+            )
+            ok = train_ok = bool(ok)  # blocks on the dispatched program
         metrics["train_loss"] = float(loss)
 
         weights_mask = jnp.ones((cfg.total_clients,), jnp.float32)
@@ -316,15 +322,19 @@ class Simulator:
 
         new_global = state["global_params"]
         if ok:
-            new_global = self.aggregate(
-                state["global_params"], stacked, sizes, weights_mask, k_agg
-            )
+            with timer.phase("aggregate"):
+                new_global = self.aggregate(
+                    state["global_params"], stacked, sizes, weights_mask, k_agg
+                )
+                jax.block_until_ready(new_global)
             if self.validation is not None:
-                val_ok, val_metrics = self.validation.test(new_global)
+                with timer.phase("validate"):
+                    val_ok, val_metrics = self.validation.test(new_global)
                 metrics.update(val_metrics)
                 ok = ok and val_ok
 
         metrics["ok"] = ok
+        metrics["phases"] = timer.durations
         new_state = dict(state)
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
@@ -343,13 +353,15 @@ class Simulator:
 
     def _run_hyper_round(self, state, rng, k_round, broadcast_number, metrics):
         cfg = self.cfg
+        timer = RoundTimer()
         active_mask = jnp.asarray(state["active_mask"])
-        stacked, sizes, new_genuine, ok, loss = self.round_step(
-            state["hnet_params"], state["prev_genuine"],
-            jnp.asarray(bool(state["have_genuine"])), active_mask, k_round,
-            jnp.asarray(broadcast_number),
-        )
-        ok = train_ok = bool(ok)
+        with timer.phase("train"):
+            stacked, sizes, new_genuine, ok, loss = self.round_step(
+                state["hnet_params"], state["prev_genuine"],
+                jnp.asarray(bool(state["have_genuine"])), active_mask, k_round,
+                jnp.asarray(broadcast_number),
+            )
+            ok = train_ok = bool(ok)
         metrics["train_loss"] = float(loss)
 
         # snapshot for detection rollback (reference: server.py:296-298)
@@ -359,16 +371,19 @@ class Simulator:
         hnet_params, opt_state = state["hnet_params"], state["hyper_opt_state"]
         new_active = np.asarray(state["active_mask"]).copy()
         if ok:
-            hnet_params, opt_state = self.hyper_update(
-                hnet_params, opt_state, stacked, active_mask
-            )
+            with timer.phase("hyper_update"):
+                hnet_params, opt_state = self.hyper_update(
+                    hnet_params, opt_state, stacked, active_mask
+                )
+                jax.block_until_ready(hnet_params)
 
             gen_params = None
             if self.detector is not None:
-                gen_params, embeddings = self.generate_all(hnet_params)
-                selected = [int(i) for i in np.flatnonzero(new_active > 0)]
-                emb_np = np.asarray(embeddings)[selected]
-                removals = self.detector.observe(broadcast_number, selected, emb_np)
+                with timer.phase("detect"):
+                    gen_params, embeddings = self.generate_all(hnet_params)
+                    selected = [int(i) for i in np.flatnonzero(new_active > 0)]
+                    emb_np = np.asarray(embeddings)[selected]
+                    removals = self.detector.observe(broadcast_number, selected, emb_np)
                 if removals:
                     print_with_color(f"Removing anomalies {removals}, rolling back", "yellow")
                     metrics["removed_clients"] = removals
@@ -378,16 +393,18 @@ class Simulator:
                     gen_params = None  # rollback invalidates the generation
 
             if self.validation is not None:
-                if gen_params is None:
-                    gen_params, _ = self.generate_all(hnet_params)
-                active_ids = jnp.asarray(np.flatnonzero(new_active > 0))
-                val_ok, val_metrics = self.validation.test_hyper(
-                    pt.tree_take(gen_params, active_ids)
-                )
+                with timer.phase("validate"):
+                    if gen_params is None:
+                        gen_params, _ = self.generate_all(hnet_params)
+                    active_ids = jnp.asarray(np.flatnonzero(new_active > 0))
+                    val_ok, val_metrics = self.validation.test_hyper(
+                        pt.tree_take(gen_params, active_ids)
+                    )
                 metrics.update(val_metrics)
                 ok = ok and val_ok
 
         metrics["ok"] = ok
+        metrics["phases"] = timer.durations
         new_state = dict(state)
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
@@ -595,13 +612,17 @@ class Simulator:
 
         while int(state["completed_rounds"]) < num_rounds:
             remaining = num_rounds - int(state["completed_rounds"])
-            # Chunk sizing doubles as a compile-cache policy: retry tails use
-            # length-1 scans (one extra compile total) instead of compiling a
-            # fresh fused program for every shrinking remainder.
+            # Chunk sizing doubles as a compile-cache policy: the first
+            # dispatch compiles one bounded-length scan (a 100-round run
+            # must not compile a length-100 program — compile time grows
+            # with scan length), repeat full chunks hit the jit cache, and
+            # retry tails use length-1 scans (one extra compile total)
+            # instead of a fresh fused program per shrinking remainder.
+            cap = chunk_size if chunk_size else DEFAULT_SCAN_CHUNK
             if chunk_size:
                 n = min(chunk_size, remaining)
-            elif first_dispatch:
-                n = remaining
+            elif first_dispatch or remaining >= cap:
+                n = min(cap, remaining)
             else:
                 n = 1
             first_dispatch = False
@@ -670,6 +691,10 @@ class Simulator:
                 if verbose:
                     keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in metrics]
                     msg = " ".join(f"{k}={metrics[k]:.4f}" for k in keys)
+                    phases = metrics.get("phases") or {}
+                    if phases:
+                        msg += " [" + ", ".join(
+                            f"{k}={v * 1e3:.0f}ms" for k, v in phases.items()) + "]"
                     print_with_color(
                         f"Round {round_no} done in {metrics['seconds']:.2f}s {msg}", "green")
             else:

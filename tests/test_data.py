@@ -75,7 +75,91 @@ def test_sampling_respects_client_pools():
     assert got.min() >= 10 and got.max() < 20
 
 
-def test_reference_pickle_fallback(tmp_path):
-    """Without reference blobs, get_dataset falls back to synthetic."""
+def test_get_dataset_synthetic_fallback(tmp_path, monkeypatch):
+    """Without reference blobs in cwd, get_dataset falls back to synthetic."""
+    monkeypatch.chdir(tmp_path)
     d = get_dataset("HAR", "train", 64, seed=0)
     assert d["x"].shape == (64, 561)
+
+
+# ---------------------------------------------------------------------------
+# real-data loaders: round-trip reference-format blobs written as fixtures
+# ---------------------------------------------------------------------------
+
+def _write_gzip_pickle(path, obj):
+    import gzip
+    import pickle
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with gzip.open(path, "wb") as fh:
+        pickle.dump(obj, fh)
+
+
+def test_reference_pickle_icu_roundtrip(tmp_path, monkeypatch):
+    """ICU blob: a torch Dataset of (vitals, labs, label) tuples, the
+    format the reference lazily gzip-unpickles per client
+    (/root/reference/src/RpcClient.py:157-162)."""
+    import torch
+
+    n = 20
+    g = torch.Generator().manual_seed(0)
+    vitals = torch.randn(n, 7, generator=g)
+    labs = torch.randn(n, 16, generator=g)
+    label = (torch.rand(n, generator=g) < 0.3).float()
+    ds = torch.utils.data.TensorDataset(vitals, labs, label)
+    _write_gzip_pickle(tmp_path / "train_dataset.pkl.gz", ds)
+
+    monkeypatch.chdir(tmp_path)
+    out = get_dataset("ICU", "train", 999, seed=0)  # size ignored: real blob
+    assert out["vitals"].shape == (n, 7) and out["vitals"].dtype == np.float32
+    assert out["labs"].shape == (n, 16)
+    np.testing.assert_allclose(out["vitals"], vitals.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(out["label"], label.numpy())
+
+
+def test_reference_pickle_har_roundtrip(tmp_path, monkeypatch):
+    """HAR blob: (x, label) tuples, x possibly (1, 561) per item
+    (/root/reference/src/RpcClient.py:155-157; Conv1d input layout)."""
+    import torch
+
+    n = 12
+    g = torch.Generator().manual_seed(1)
+    x = torch.randn(n, 1, 561, generator=g)
+    label = torch.randint(0, 6, (n,), generator=g)
+    ds = torch.utils.data.TensorDataset(x, label)
+    _write_gzip_pickle(tmp_path / "data" / "icu_har_test_ds.pkl.gz", ds)
+
+    monkeypatch.chdir(tmp_path)
+    out = get_dataset("HAR", "test", 999, seed=0)
+    assert out["x"].shape == (n, 561)  # (1, 561) squeezed
+    assert out["label"].dtype == np.int32
+    np.testing.assert_allclose(out["x"], x.numpy()[:, 0, :], rtol=1e-6)
+
+
+def test_cifar10_batches_roundtrip(tmp_path, monkeypatch):
+    """CIFAR-10 in the torchvision on-disk layout the reference downloads
+    (root './data', /root/reference/src/Validation.py:38-44): pixel u8 /255
+    then Normalize(.5, .5) => [-1, 1], NHWC out."""
+    import pickle
+
+    rng = np.random.default_rng(3)
+    bdir = tmp_path / "data" / "cifar-10-batches-py"
+    bdir.mkdir(parents=True)
+    raw = {}
+    for name, n in [("data_batch_%d" % i, 4) for i in range(1, 6)] + [("test_batch", 6)]:
+        data = rng.integers(0, 256, size=(n, 3072), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n).tolist()
+        with open(bdir / name, "wb") as fh:
+            pickle.dump({b"data": data, b"labels": labels}, fh)
+        raw[name] = (data, labels)
+
+    monkeypatch.chdir(tmp_path)
+    train = get_dataset("CIFAR10", "train", 999, seed=0)
+    test = get_dataset("CIFAR10", "test", 999, seed=0)
+    assert train["x"].shape == (20, 32, 32, 3) and test["x"].shape == (6, 32, 32, 3)
+    assert train["x"].min() >= -1.0 and train["x"].max() <= 1.0
+    # spot-check one pixel against the reference transform chain
+    d0 = raw["data_batch_1"][0][0].reshape(3, 32, 32)
+    expect = (d0[0, 0, 0] / 255.0 - 0.5) / 0.5
+    np.testing.assert_allclose(train["x"][0, 0, 0, 0], expect, rtol=1e-6)
+    assert list(test["label"]) == raw["test_batch"][1]

@@ -48,6 +48,34 @@ def test_fused_rejects_host_side_modes(tmp_path):
         sim.run_scan(sim.init_state(), 2)
 
 
+def test_default_chunk_policy_bounds_compiles(tmp_path):
+    """Without chunk_size, run_fast must dispatch scans only of length
+    DEFAULT_SCAN_CHUNK (16) or of bounded tail lengths — never compile a
+    scan as long as the whole run (a 100-round run would otherwise compile
+    a length-100 program)."""
+    from attackfl_tpu.training.engine import DEFAULT_SCAN_CHUNK
+
+    cfg = Config(mode="fedavg", log_path=str(tmp_path), **{
+        **BASE, "num_round": 2 * DEFAULT_SCAN_CHUNK + 3, "validation": False,
+        "total_clients": 4, "attacks": (),
+    })
+    sim = Simulator(cfg)
+    lengths = []
+    real = sim.run_scan
+
+    def spy(state, n):
+        lengths.append(n)
+        return real(state, n)
+
+    sim.run_scan = spy
+    state, hist = sim.run_fast(state=sim.init_state(), save_checkpoints=False,
+                               verbose=False)
+    assert int(state["completed_rounds"]) == 2 * DEFAULT_SCAN_CHUNK + 3
+    assert max(lengths) == DEFAULT_SCAN_CHUNK
+    # only two distinct compiled lengths: the chunk and the length-1 tail
+    assert set(lengths) == {DEFAULT_SCAN_CHUNK, 1}, lengths
+
+
 @pytest.mark.slow
 def test_fused_chunking_and_counters(tmp_path):
     cfg = Config(mode="fedavg", log_path=str(tmp_path), **BASE)

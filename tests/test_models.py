@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from attackfl_tpu.models import make_hypernetwork
-from attackfl_tpu.models.layers import adaptive_avg_pool1d, adaptive_max_pool1d
+from attackfl_tpu.models.layers import adaptive_avg_pool1d
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.registry import MODEL_REGISTRY, get_model
 
@@ -71,14 +71,12 @@ def test_rnn_masks_sentinel_values(rng):
     np.testing.assert_allclose(np.asarray(masked), np.asarray(zeros), atol=1e-6)
 
 
-def test_adaptive_pools_match_torch_semantics():
+def test_adaptive_pool_matches_torch_semantics():
     # torch AdaptiveAvgPool1d(4) over length 7: bins [0:2],[1:4],[3:6],[5:7]
     x = jnp.arange(7, dtype=jnp.float32)[None, :, None]
     out = np.asarray(adaptive_avg_pool1d(x, 4))[0, :, 0]
     expected = [np.mean([0, 1]), np.mean([1, 2, 3]), np.mean([3, 4, 5]), np.mean([5, 6])]
     np.testing.assert_allclose(out, expected)
-    mx = np.asarray(adaptive_max_pool1d(x, 4))[0, :, 0]
-    np.testing.assert_allclose(mx, [1, 3, 5, 6])
 
 
 def test_hypernetwork_generates_target_structure(rng):
