@@ -122,12 +122,6 @@ class Simulator:
                 "stats and needs the full client matrix locally; run it "
                 "single-process (the matrices are tiny — SURVEY.md §7)"
             )
-        if cfg.local_backend == "pallas" and self.mesh is not None:
-            raise ValueError(
-                "local_backend 'pallas' is the single-chip fused fast path; "
-                "it does not shard over the client mesh (use local_backend "
-                "'xla' with use_mesh, or drop the mesh)"
-            )
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
         # ---- validation -------------------------------------------------
@@ -169,7 +163,7 @@ class Simulator:
         else:
             round_step = build_round_step(
                 self.model, cfg, self.train_data, self.attack_groups,
-                self.genuine_idx, self.client_pools, constrain,
+                self.genuine_idx, self.client_pools, constrain, mesh=self.mesh,
             )
             self.round_step = jax.jit(round_step)
             self._round_step_raw = round_step

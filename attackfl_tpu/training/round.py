@@ -82,6 +82,7 @@ def build_round_step(
     genuine_idx: Sequence[int],
     client_pools: jnp.ndarray | None = None,
     constrain: Callable | None = None,
+    mesh=None,
 ) -> Callable:
     """Build ``round_step(global_params, prev_genuine, have_genuine, rng,
     broadcast_number) -> (stacked, sizes, new_genuine, ok, mean_loss)``.
@@ -121,6 +122,21 @@ def build_round_step(
             dropout=(0.1, 0.1, float(getattr(model, "dropout_rate", 0.3))),
             interpret=interpret,
         )
+        if mesh is not None:
+            # perf lever x scale lever: run the kernel per-device on its
+            # client shard.  The grid already chunks clients; shard_map
+            # splits the leading axis so each device's Pallas program sees
+            # C/n_dev clients (params replicated, per-client rows sharded).
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            ax = cfg.mesh.axis_name
+            batched_update = shard_map(
+                batched_update, mesh=mesh,
+                in_specs=(P(), P(ax), P(ax), P(ax)),
+                out_specs=(P(ax), P(ax), P(ax)),
+                check_rep=False,
+            )
     else:
         local_update = build_local_update(
             model, cfg.data_name, train_data,
