@@ -135,7 +135,9 @@ def build_round_step(
                 batched_update, mesh=mesh,
                 in_specs=(P(), P(ax), P(ax), P(ax)),
                 out_specs=(P(ax), P(ax), P(ax)),
-                check_rep=False,
+                # the pallas_call's ShapeDtypeStructs carry no vma info, so
+                # the varying-across-mesh check can't see through it
+                check_vma=False,
             )
     else:
         local_update = build_local_update(
