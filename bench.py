@@ -1,42 +1,44 @@
-"""Benchmark: FL rounds/sec on the BASELINE.md headline configuration.
+"""Benchmark: FL rounds/sec across the BASELINE.md configurations.
 
-Workload (BASELINE.json config 4 family): ICU TransformerModel, 100
-clients, FedAvg, LIE attackers at genuine-rate 0.5, full reference
-hyperparameters (5 local epochs, batch 128, 12k-15k samples/client/round —
-config.yaml:17-20,31-37), validation on.  The entire round — per-client
-Adam training vmapped over the client axis, attack synthesis, weighted
-aggregation, ROC-AUC validation — runs as jitted XLA programs on the TPU.
+Default invocation (the driver's) measures the headline workload —
+BASELINE.json config 4: ICU TransformerModel, 100 clients, FedAvg, 20 LIE
+attackers at genuine-rate 0.5, full reference hyperparameters (5 local
+epochs, batch 128, 12k-15k samples/client/round — config.yaml:17-20,31-37),
+validation on — on BOTH local-training backends (xla and the Pallas fused
+kernel) when running on TPU, and additionally runs the north-star-scale
+1000-client workload.
 
 Prints ONE JSON line:
   {"metric": "fl_rounds_per_sec_100c", "value": N, "unit": "rounds/s",
-   "vs_baseline": N}
+   "vs_baseline": N, "detail": {...}}
 
-vs_baseline is measured against the driver's north-star rate
-(1000 clients x 100 rounds in < 60 s on a v4-8 => 1.667 rounds/s;
-/root/repo/BASELINE.json) — the reference itself publishes no numbers
-(BASELINE.md), so the north star is the only quantitative anchor.
+``value`` is the best backend's rounds/s at 100 clients.  ``vs_baseline``
+divides by the north-star rate (1000 clients x 100 rounds < 60 s on a
+v4-8 => 1.667 rounds/s; /root/repo/BASELINE.json — the reference itself
+publishes no numbers, BASELINE.md).  HONEST FRAMING: the headline runs
+100 clients on ONE chip while the north star is 1000 clients on a v4-8
+(4 chips, 250 clients/chip) — the per-chip-equivalent comparison is the
+``north_star_1000c`` detail entry, which runs the full 1000-client
+workload on this single chip against the same 1.667 rounds/s bar.
+
+Other configs: ``python bench.py --config N`` (N in 1..5) measures one
+BASELINE table row; ``--backend``, ``--clients``, ``--rounds`` override
+the workload (VERDICT round-2 next-steps #1/#2).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
-
-import jax
 
 NORTH_STAR_ROUNDS_PER_SEC = 100.0 / 60.0  # BASELINE.json north star
 
 
-def main() -> None:
-    from attackfl_tpu.config import AttackSpec, Config
-    from attackfl_tpu.training.engine import Simulator
-
-    cfg = Config(
-        num_round=5,
-        total_clients=100,
-        mode="fedavg",
-        model="TransformerModel",
-        data_name="ICU",
+def _base_kwargs(log_path: str) -> dict:
+    """Reference hyperparameters shared by every BASELINE config
+    (config.yaml:17-20,31-37)."""
+    return dict(
         num_data_range=(12000, 15000),
         epochs=5,
         batch_size=128,
@@ -46,38 +48,178 @@ def main() -> None:
         validation=True,
         train_size=20000,
         test_size=4000,
-        attacks=(AttackSpec(mode="LIE", num_clients=20, attack_round=2, args=(0.74,)),),
         scan_unroll=4,
-        log_path="/tmp/attackfl_bench",
+        log_path=log_path,
     )
+
+
+def make_config(n: int, log_path: str = "/tmp/attackfl_bench"):
+    """BASELINE.json configs 1-5 (BASELINE.md table)."""
+    from attackfl_tpu.config import AttackSpec, Config
+
+    base = _base_kwargs(log_path)
+    if n == 1:  # ICU CNNModel, 3 clients, FedAvg, no attack (config.yaml defaults)
+        return Config(num_round=30, total_clients=3, mode="fedavg",
+                      model="CNNModel", data_name="ICU", **base)
+    if n == 2:  # ICU RNNModel, 3 clients, hyper mode, no attack
+        return Config(num_round=30, total_clients=3, mode="hyper",
+                      model="RNNModel", data_name="ICU", **base)
+    if n == 3:  # ICU TransformerModel, 100 clients, FedAvg, non-IID split
+        return Config(num_round=30, total_clients=100, mode="fedavg",
+                      model="TransformerModel", data_name="ICU",
+                      partition="dirichlet", dirichlet_alpha=0.5, **base)
+    if n == 4:  # headline: +LIE attackers
+        return Config(num_round=30, total_clients=100, mode="fedavg",
+                      model="TransformerModel", data_name="ICU",
+                      attacks=(AttackSpec(mode="LIE", num_clients=20,
+                                          attack_round=2, args=(0.74,)),),
+                      **base)
+    if n == 5:  # CIFAR-10 ResNet-18, FedAvg + Opt-Fang.  The BASELINE row
+        # says 1000 clients sharded over a v4 pod; 1000 stacked ResNet-18
+        # replicas (~44 GB of params+opt state) exceed one chip's HBM, so
+        # the single-chip row measures 16 clients and the 1000-client
+        # geometry is validated on the virtual mesh (tests/test_sharding).
+        base = dict(base, num_data_range=(256, 512), train_size=4096,
+                    test_size=1024, epochs=1, batch_size=64)
+        return Config(num_round=10, total_clients=16, mode="fedavg",
+                      model="ResNet18", data_name="CIFAR10",
+                      attacks=(AttackSpec(mode="Opt-Fang", num_clients=3,
+                                          attack_round=2, args=(50.0, 1.0)),),
+                      **base)
+    raise ValueError(f"unknown BASELINE config {n}")
+
+
+def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll")) -> dict:
+    """Compile + run ``n_rounds`` via the fused scan (or run() for
+    host-side modes), return rounds/s and the final quality metric."""
+    import jax
+
+    from attackfl_tpu.training.engine import Simulator
+
     sim = Simulator(cfg)
-    n_rounds = 4
+    out: dict = {}
+    if sim.supports_fused():
+        state = sim.init_state()
+        t0 = time.perf_counter()
+        state, metrics = sim.run_scan(state, n_rounds)  # compile + run
+        jax.block_until_ready(metrics)
+        out["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
+        assert all(map(bool, metrics["ok"])), f"warmup rounds failed: {metrics}"
+        t0 = time.perf_counter()
+        state, metrics = sim.run_scan(state, n_rounds)
+        jax.block_until_ready(metrics)
+        elapsed = time.perf_counter() - t0
+        assert all(map(bool, metrics["ok"])), f"timed rounds failed: {metrics}"
+        final = {k: float(v[-1]) for k, v in metrics.items() if k != "ok"}
+    else:  # host-side defense modes: per-round path
+        state = sim.init_state()
+        state, m = sim.run_round(state)  # warmup/compile
+        assert m["ok"], f"warmup round failed: {m}"
+        t0 = time.perf_counter()
+        hist = []
+        for _ in range(n_rounds):
+            state, m = sim.run_round(state)
+            hist.append(m)
+        elapsed = time.perf_counter() - t0
+        assert all(h["ok"] for h in hist), f"timed rounds failed: {hist[-1]}"
+        final = {k: v for k, v in hist[-1].items()
+                 if isinstance(v, float)}
+    out["rounds_per_sec"] = round(n_rounds / elapsed, 4)
+    out["seconds_per_round"] = round(elapsed / n_rounds, 4)
+    for k in metric_keys:
+        if k in final and final[k] == final[k]:
+            out[k] = round(final[k], 4)
+    return out
 
-    # warmup: run the same n-round fused scan once (compiles it), excluded
-    # from timing
-    state = sim.init_state()
-    state, metrics = sim.run_scan(state, n_rounds)
-    jax.block_until_ready(metrics)
-    assert all(map(bool, metrics["ok"])), f"warmup rounds failed: {metrics}"
 
-    t0 = time.perf_counter()
-    state, metrics = sim.run_scan(state, n_rounds)
-    jax.block_until_ready(metrics)
-    elapsed = time.perf_counter() - t0
-    rounds_per_sec = n_rounds / elapsed
-    assert all(map(bool, metrics["ok"])), f"timed rounds failed: {metrics}"
-    metrics = {k: v[-1] for k, v in metrics.items()}
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=int, default=None,
+                        help="single BASELINE config 1-5 (default: headline suite)")
+    parser.add_argument("--backend", choices=["xla", "pallas"], default=None)
+    parser.add_argument("--clients", type=int, default=None)
+    parser.add_argument("--rounds", type=int, default=4,
+                        help="timed rounds per measurement")
+    parser.add_argument("--skip-north-star", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    if args.config is None and (args.backend or args.clients):
+        parser.error("--backend/--clients apply to a single row; add --config N")
+
+    if args.config is not None:  # single-row mode (BASELINE.md table filling)
+        cfg = make_config(args.config)
+        if args.clients:
+            cfg = cfg.replace(total_clients=args.clients)
+        if args.backend:
+            cfg = cfg.replace(local_backend=args.backend)
+        res = measure(cfg, args.rounds)
+        print(json.dumps({
+            "metric": f"fl_rounds_per_sec_config{args.config}",
+            "value": res["rounds_per_sec"],
+            "unit": "rounds/s",
+            "vs_baseline": round(res["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
+            "detail": res,
+        }))
+        return
+
+    # ---- headline suite (driver default) --------------------------------
+    detail: dict = {
+        "config": "ICU TransformerModel, 100 clients, FedAvg + 20 LIE attackers",
+        "baseline_note": (
+            "north star = 1000 clients x 100 rounds < 60 s on v4-8 "
+            "(4 chips => 250 clients/chip); this chip runs the FULL "
+            "1000-client workload in north_star_1000c"
+        ),
+    }
+    results = {}
+    cfg4 = make_config(4)
+    results["xla"] = measure(cfg4, args.rounds)
+    if on_tpu:
+        # the Pallas fused kernel is TPU-only (interpret mode is a CPU
+        # correctness path, not a perf path — ops/fused_step.py)
+        try:
+            results["pallas"] = measure(
+                cfg4.replace(local_backend="pallas"), args.rounds)
+        except Exception as e:  # noqa: BLE001 — bench must survive kernel regressions
+            results["pallas"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    detail["backends_100c"] = results
+
+    best_name, best = max(
+        ((k, v) for k, v in results.items() if "rounds_per_sec" in v),
+        key=lambda kv: kv[1]["rounds_per_sec"],
+    )
+    detail["best_backend"] = best_name
+    detail["roc_auc_final"] = best.get("roc_auc")
+    detail["seconds_per_round"] = best["seconds_per_round"]
+
+    # north star is a TPU-scale workload (1000 clients, full reference
+    # hyperparameters) — off-TPU it would grind a CPU box for hours
+    if not args.skip_north_star and on_tpu:
+        from attackfl_tpu.config import AttackSpec
+
+        ns_cfg = cfg4.replace(
+            total_clients=1000,
+            attacks=(AttackSpec(mode="LIE", num_clients=200, attack_round=2,
+                                args=(0.74,)),),
+        )
+        try:
+            ns = measure(ns_cfg, 2)
+            ns["vs_north_star"] = round(
+                ns["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4)
+            detail["north_star_1000c"] = ns
+        except Exception as e:  # noqa: BLE001
+            detail["north_star_1000c"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps({
         "metric": "fl_rounds_per_sec_100c",
-        "value": round(rounds_per_sec, 4),
+        "value": best["rounds_per_sec"],
         "unit": "rounds/s",
-        "vs_baseline": round(rounds_per_sec / NORTH_STAR_ROUNDS_PER_SEC, 4),
-        "detail": {
-            "config": "ICU TransformerModel, 100 clients, FedAvg + 20 LIE attackers",
-            "roc_auc_final": round(float(metrics.get("roc_auc", float("nan"))), 4),
-            "seconds_per_round": round(elapsed / n_rounds, 4),
-        },
+        "vs_baseline": round(best["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
+        "detail": detail,
     }))
 
 
