@@ -166,6 +166,11 @@ class Config:
             )
         if self.scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.mesh.compute_dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError(
+                f"Unknown compute-dtype {self.mesh.compute_dtype!r}; choose "
+                "float32, bfloat16 or float16"
+            )
         if self.local_backend not in ("xla", "pallas"):
             raise ValueError(
                 f"Unknown local_backend {self.local_backend!r}; choose xla or pallas"
@@ -176,6 +181,12 @@ class Config:
             raise ValueError(
                 "local_backend 'pallas' implements the flagship "
                 "TransformerModel-on-ICU step only; use local_backend 'xla'"
+            )
+        if self.local_backend == "pallas" and self.mesh.compute_dtype != "float32":
+            raise ValueError(
+                "local_backend 'pallas' computes in float32 (the fused "
+                "kernel is hardwired f32); compute-dtype applies to the "
+                "xla backend only"
             )
         if self.local_backend == "pallas" and self.mode == "hyper":
             raise ValueError(

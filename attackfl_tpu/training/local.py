@@ -30,41 +30,57 @@ import optax
 Batch = dict[str, jnp.ndarray]
 
 
-def make_loss_fn(model, data_name: str) -> Callable:
+def make_loss_fn(model, data_name: str, compute_dtype=None) -> Callable:
     """Per-batch masked mean loss.
 
     ICU -> BCE on sigmoid outputs (client.py:77), HAR -> softmax CE on
     logits (client.py:117), CIFAR10 -> NLL on log-prob outputs (the
     validation contract, src/Validation.py:76).
+
+    ``compute_dtype`` (e.g. jnp.bfloat16) runs the model forward/backward
+    in that dtype — parameters are cast on the way into ``model.apply``
+    and the loss is reduced in float32, so the f32 master params, Adam
+    state and loss tripwire are unchanged (mixed-precision: the MXU eats
+    bf16 natively; cfg.mesh.compute_dtype).
     """
+
+    def cast_in(params, batch):
+        if compute_dtype is None:
+            return params, batch
+        c = lambda x: (x.astype(compute_dtype)
+                       if jnp.issubdtype(x.dtype, jnp.floating) else x)
+        return jax.tree.map(c, params), {k: c(v) for k, v in batch.items()}
 
     if data_name == "ICU":
 
         def loss_fn(params, batch: Batch, mask, rng):
+            params, batch = cast_in(params, batch)
             probs = model.apply(
                 {"params": params}, batch["vitals"], batch["labs"], train=True,
                 rngs={"dropout": rng},
-            )[:, 0]
+            )[:, 0].astype(jnp.float32)
             probs = jnp.clip(probs, 1e-7, 1.0 - 1e-7)
-            y = batch["label"]
+            y = batch["label"].astype(jnp.float32)
             per = -(y * jnp.log(probs) + (1.0 - y) * jnp.log(1.0 - probs))
             return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     elif data_name == "HAR":
 
         def loss_fn(params, batch: Batch, mask, rng):
+            params, batch = cast_in(params, batch)
             logits = model.apply(
                 {"params": params}, batch["x"], train=True, rngs={"dropout": rng}
-            )
+            ).astype(jnp.float32)
             per = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
             return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     elif data_name == "CIFAR10":
 
         def loss_fn(params, batch: Batch, mask, rng):
+            params, batch = cast_in(params, batch)
             logp = model.apply(
                 {"params": params}, batch["x"], train=True, rngs={"dropout": rng}
-            )
+            ).astype(jnp.float32)
             per = -jnp.take_along_axis(logp, batch["label"][:, None], axis=1)[:, 0]
             return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
@@ -94,6 +110,7 @@ def build_local_update(
     lr: float,
     clip_grad_norm: float,
     scan_unroll: int = 1,
+    compute_dtype=None,
 ) -> Callable:
     """Build ``local_update(params, rng, idx, mask) -> (params, ok, loss)``.
 
@@ -103,7 +120,7 @@ def build_local_update(
     (client.py:78).  vmap over the leading client axis with
     ``in_axes=(0 or None, 0, 0, 0)``.
     """
-    loss_fn = make_loss_fn(model, data_name)
+    loss_fn = make_loss_fn(model, data_name, compute_dtype)
     tx = make_optimizer(lr, clip_grad_norm)
     grad_fn = jax.value_and_grad(loss_fn)
 

@@ -89,6 +89,19 @@ def make_config(n: int, log_path: str = "/tmp/attackfl_bench"):
     raise ValueError(f"unknown BASELINE config {n}")
 
 
+def north_star_config(log_path: str = "/tmp/attackfl_bench"):
+    """The BASELINE.json north-star workload: 1000 clients, 20% LIE
+    attackers, full reference hyperparameters (single source of truth —
+    scripts/measure_baseline.py reuses this)."""
+    from attackfl_tpu.config import AttackSpec
+
+    return make_config(4, log_path).replace(
+        total_clients=1000,
+        attacks=(AttackSpec(mode="LIE", num_clients=200, attack_round=2,
+                            args=(0.74,)),),
+    )
+
+
 def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll")) -> dict:
     """Compile + run ``n_rounds`` via the fused scan (or run() for
     host-side modes), return rounds/s and the final quality metric."""
@@ -199,15 +212,8 @@ def main() -> None:
     # north star is a TPU-scale workload (1000 clients, full reference
     # hyperparameters) — off-TPU it would grind a CPU box for hours
     if not args.skip_north_star and on_tpu:
-        from attackfl_tpu.config import AttackSpec
-
-        ns_cfg = cfg4.replace(
-            total_clients=1000,
-            attacks=(AttackSpec(mode="LIE", num_clients=200, attack_round=2,
-                                args=(0.74,)),),
-        )
         try:
-            ns = measure(ns_cfg, 2)
+            ns = measure(north_star_config(), 2)
             ns["vs_north_star"] = round(
                 ns["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4)
             detail["north_star_1000c"] = ns

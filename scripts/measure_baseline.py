@@ -1,0 +1,92 @@
+"""Fill BASELINE.md's table: measure every BASELINE config on this chip.
+
+Runs (TPU expected; CPU works but is not the target):
+  1. configs 1-5 via bench.make_config / bench.measure
+  2. the headline config on both local-training backends (xla vs pallas)
+  3. the 1000-client north-star workload
+  4. a full 100-round TransformerModel run end-to-end (compile + run),
+     the VERDICT round-2 item #4 measurement
+
+Usage: python scripts/measure_baseline.py [--rounds 4] [--out /tmp/baseline_rows.json]
+Prints one JSON object per measurement line; the final line is the
+aggregate dict (also written to --out).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rounds", type=int, default=4)
+    parser.add_argument("--out", type=str, default="/tmp/baseline_rows.json")
+    parser.add_argument("--skip", type=str, default="",
+                        help="comma-separated step names to skip")
+    args = parser.parse_args()
+    skip = set(args.skip.split(",")) if args.skip else set()
+
+    import jax
+
+    from attackfl_tpu.config import AttackSpec
+    from attackfl_tpu.training.engine import Simulator
+
+    out: dict = {"backend": jax.default_backend(),
+                 "device": str(jax.devices()[0])}
+
+    def record(name, fn):
+        if name in skip:
+            return
+        t0 = time.time()
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — keep measuring other rows
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:400]}
+        out[name]["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps({name: out[name]}), flush=True)
+
+    for n in range(1, 6):
+        record(f"config{n}", lambda n=n: bench.measure(
+            bench.make_config(n), args.rounds))
+
+    record("config4_pallas", lambda: bench.measure(
+        bench.make_config(4).replace(local_backend="pallas"), args.rounds))
+
+    def north_star():
+        res = bench.measure(bench.north_star_config(), 2)
+        res["vs_north_star"] = round(
+            res["rounds_per_sec"] / bench.NORTH_STAR_ROUNDS_PER_SEC, 4)
+        return res
+
+    record("north_star_1000c", north_star)
+
+    def hundred_rounds():
+        cfg = bench.make_config(4).replace(num_round=100)
+        sim = Simulator(cfg)
+        t0 = time.time()
+        state, hist = sim.run_fast(save_checkpoints=False, verbose=False)
+        total = time.time() - t0
+        ok = sum(1 for h in hist if h["ok"])
+        out = {"total_s": round(total, 1), "ok_rounds": ok,
+               "rounds_per_sec_incl_compile": round(ok / total, 4)}
+        auc = hist[-1].get("roc_auc")
+        if auc is not None and auc == auc:  # NaN-guard: keep JSON strict
+            out["roc_auc_final"] = round(auc, 4)
+        return out
+
+    record("run_100_rounds_e2e", hundred_rounds)
+
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
