@@ -78,6 +78,8 @@ def build_hyper_round(
         epochs=cfg.epochs, batch_size=cfg.batch_size,
         lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
         scan_unroll=cfg.scan_unroll,
+        compute_dtype=(jnp.dtype(cfg.mesh.compute_dtype).type
+                       if cfg.mesh.compute_dtype != "float32" else None),
     )
 
     constrain = constrain or (lambda tree: tree)

@@ -102,15 +102,22 @@ def north_star_config(log_path: str = "/tmp/attackfl_bench"):
     )
 
 
-def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll")) -> dict:
+def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
+            trace_dir: str | None = None) -> dict:
     """Compile + run ``n_rounds`` via the fused scan (or run() for
-    host-side modes), return rounds/s and the final quality metric."""
+    host-side modes), return rounds/s and the final quality metric.
+    ``trace_dir`` captures a jax.profiler trace of the timed section
+    (inspect with tensorboard / xprof — SURVEY.md §5 tracing)."""
+    import contextlib
+
     import jax
 
     from attackfl_tpu.training.engine import Simulator
 
     sim = Simulator(cfg)
     out: dict = {}
+    tracer = (jax.profiler.trace(trace_dir) if trace_dir
+              else contextlib.nullcontext())
     if sim.supports_fused():
         state = sim.init_state()
         t0 = time.perf_counter()
@@ -119,8 +126,9 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll")) -> d
         out["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
         assert all(map(bool, metrics["ok"])), f"warmup rounds failed: {metrics}"
         t0 = time.perf_counter()
-        state, metrics = sim.run_scan(state, n_rounds)
-        jax.block_until_ready(metrics)
+        with tracer:
+            state, metrics = sim.run_scan(state, n_rounds)
+            jax.block_until_ready(metrics)
         elapsed = time.perf_counter() - t0
         assert all(map(bool, metrics["ok"])), f"timed rounds failed: {metrics}"
         final = {k: float(v[-1]) for k, v in metrics.items() if k != "ok"}
@@ -130,9 +138,10 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll")) -> d
         assert m["ok"], f"warmup round failed: {m}"
         t0 = time.perf_counter()
         hist = []
-        for _ in range(n_rounds):
-            state, m = sim.run_round(state)
-            hist.append(m)
+        with tracer:
+            for _ in range(n_rounds):
+                state, m = sim.run_round(state)
+                hist.append(m)
         elapsed = time.perf_counter() - t0
         assert all(h["ok"] for h in hist), f"timed rounds failed: {hist[-1]}"
         final = {k: v for k, v in hist[-1].items()
@@ -154,6 +163,9 @@ def main() -> None:
     parser.add_argument("--rounds", type=int, default=4,
                         help="timed rounds per measurement")
     parser.add_argument("--skip-north-star", action="store_true")
+    parser.add_argument("--trace", type=str, default=None,
+                        help="capture a jax.profiler trace of the timed "
+                             "section into this directory (single-row mode)")
     args = parser.parse_args()
 
     import jax
@@ -169,7 +181,7 @@ def main() -> None:
             cfg = cfg.replace(total_clients=args.clients)
         if args.backend:
             cfg = cfg.replace(local_backend=args.backend)
-        res = measure(cfg, args.rounds)
+        res = measure(cfg, args.rounds, trace_dir=args.trace)
         print(json.dumps({
             "metric": f"fl_rounds_per_sec_config{args.config}",
             "value": res["rounds_per_sec"],

@@ -36,11 +36,16 @@ def main() -> None:
 
     import jax
 
-    from attackfl_tpu.config import AttackSpec
     from attackfl_tpu.training.engine import Simulator
 
     out: dict = {"backend": jax.default_backend(),
                  "device": str(jax.devices()[0])}
+    if jax.default_backend() != "tpu":
+        # same guards as bench.main: pallas off-TPU is interpret mode (a
+        # correctness path that would grind for hours at bench scale) and
+        # the 1000-client north star is a TPU-scale workload
+        skip |= {"config4_pallas", "north_star_1000c"}
+        out["note"] = "off-TPU: pallas + north-star steps auto-skipped"
 
     def record(name, fn):
         if name in skip:
@@ -75,12 +80,12 @@ def main() -> None:
         state, hist = sim.run_fast(save_checkpoints=False, verbose=False)
         total = time.time() - t0
         ok = sum(1 for h in hist if h["ok"])
-        out = {"total_s": round(total, 1), "ok_rounds": ok,
+        row = {"total_s": round(total, 1), "ok_rounds": ok,
                "rounds_per_sec_incl_compile": round(ok / total, 4)}
         auc = hist[-1].get("roc_auc")
         if auc is not None and auc == auc:  # NaN-guard: keep JSON strict
-            out["roc_auc_final"] = round(auc, 4)
-        return out
+            row["roc_auc_final"] = round(auc, 4)
+        return row
 
     record("run_100_rounds_e2e", hundred_rounds)
 
