@@ -29,7 +29,7 @@ from attackfl_tpu.config import Config
 from attackfl_tpu.data.partition import sample_round_indices
 from attackfl_tpu.ops import attacks
 from attackfl_tpu.ops import pytree as pt
-from attackfl_tpu.training.local import build_local_update
+from attackfl_tpu.training.local import build_local_update, resolve_compute_dtype
 from attackfl_tpu.training.round import AttackGroup
 
 Batch = dict[str, jnp.ndarray]
@@ -78,8 +78,7 @@ def build_hyper_round(
         epochs=cfg.epochs, batch_size=cfg.batch_size,
         lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
         scan_unroll=cfg.scan_unroll,
-        compute_dtype=(jnp.dtype(cfg.mesh.compute_dtype).type
-                       if cfg.mesh.compute_dtype != "float32" else None),
+        compute_dtype=resolve_compute_dtype(cfg.mesh.compute_dtype),
     )
 
     constrain = constrain or (lambda tree: tree)

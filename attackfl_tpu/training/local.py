@@ -30,6 +30,12 @@ import optax
 Batch = dict[str, jnp.ndarray]
 
 
+def resolve_compute_dtype(name: str):
+    """cfg.mesh.compute_dtype -> dtype for :func:`make_loss_fn` (None =
+    full f32, i.e. no mixed-precision casting)."""
+    return jnp.dtype(name).type if name != "float32" else None
+
+
 def make_loss_fn(model, data_name: str, compute_dtype=None) -> Callable:
     """Per-batch masked mean loss.
 

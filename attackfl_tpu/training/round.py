@@ -36,7 +36,9 @@ from attackfl_tpu.config import Config
 from attackfl_tpu.data.partition import sample_round_indices
 from attackfl_tpu.ops import aggregators, attacks
 from attackfl_tpu.ops import pytree as pt
-from attackfl_tpu.training.local import build_local_update, build_root_update
+from attackfl_tpu.training.local import (
+    build_local_update, build_root_update, resolve_compute_dtype,
+)
 
 Batch = dict[str, jnp.ndarray]
 
@@ -140,13 +142,12 @@ def build_round_step(
                 check_vma=False,
             )
     else:
-        compute_dtype = (jnp.dtype(cfg.mesh.compute_dtype).type
-                         if cfg.mesh.compute_dtype != "float32" else None)
         local_update = build_local_update(
             model, cfg.data_name, train_data,
             epochs=cfg.epochs, batch_size=cfg.batch_size,
             lr=cfg.lr, clip_grad_norm=cfg.clip_grad_norm,
-            scan_unroll=cfg.scan_unroll, compute_dtype=compute_dtype,
+            scan_unroll=cfg.scan_unroll,
+            compute_dtype=resolve_compute_dtype(cfg.mesh.compute_dtype),
         )
         batched_update = jax.vmap(local_update, in_axes=(None, 0, 0, 0))
     constrain = constrain or (lambda tree: tree)

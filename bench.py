@@ -89,6 +89,38 @@ def make_config(n: int, log_path: str = "/tmp/attackfl_bench"):
     raise ValueError(f"unknown BASELINE config {n}")
 
 
+def tpu_init_watchdog(metric: str, seconds: float = 600.0):
+    """TPU backend init goes through the axon tunnel, which can hang
+    indefinitely when the chip lease is wedged — emit a diagnostic JSON
+    line and exit instead of hanging the caller.  Returns a cancel()
+    callable to invoke once backend init has completed.  Shared by
+    bench.main and scripts/measure_baseline.py."""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def _boom():
+        if not done.is_set():
+            print(json.dumps({
+                "metric": metric, "value": 0.0, "unit": "rounds/s",
+                "vs_baseline": 0.0,
+                "detail": {"error": "TPU backend init did not complete "
+                                    f"within {seconds:.0f}s (axon tunnel down?)"},
+            }), flush=True)
+            os._exit(2)
+
+    timer = threading.Timer(seconds, _boom)
+    timer.daemon = True
+    timer.start()
+
+    def cancel():
+        done.set()
+        timer.cancel()
+
+    return cancel
+
+
 def north_star_config(log_path: str = "/tmp/attackfl_bench"):
     """The BASELINE.json north-star workload: 1000 clients, 20% LIE
     attackers, full reference hyperparameters (single source of truth —
@@ -168,12 +200,18 @@ def main() -> None:
                              "section into this directory (single-row mode)")
     args = parser.parse_args()
 
+    if args.config is None and (args.backend or args.clients or args.trace):
+        parser.error("--backend/--clients/--trace apply to a single row; "
+                     "add --config N")
+
+    metric_name = ("fl_rounds_per_sec_100c" if args.config is None
+                   else f"fl_rounds_per_sec_config{args.config}")
+    cancel_watchdog = tpu_init_watchdog(metric_name)
+
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
-
-    if args.config is None and (args.backend or args.clients):
-        parser.error("--backend/--clients apply to a single row; add --config N")
+    cancel_watchdog()
 
     if args.config is not None:  # single-row mode (BASELINE.md table filling)
         cfg = make_config(args.config)

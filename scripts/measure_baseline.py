@@ -34,12 +34,15 @@ def main() -> None:
     args = parser.parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
 
+    cancel_watchdog = bench.tpu_init_watchdog("baseline_table")
+
     import jax
 
     from attackfl_tpu.training.engine import Simulator
 
     out: dict = {"backend": jax.default_backend(),
                  "device": str(jax.devices()[0])}
+    cancel_watchdog()
     if jax.default_backend() != "tpu":
         # same guards as bench.main: pallas off-TPU is interpret mode (a
         # correctness path that would grind for hours at bench scale) and
