@@ -221,7 +221,7 @@ def main() -> None:
             cfg = cfg.replace(local_backend=args.backend)
         res = measure(cfg, args.rounds, trace_dir=args.trace)
         print(json.dumps({
-            "metric": f"fl_rounds_per_sec_config{args.config}",
+            "metric": metric_name,
             "value": res["rounds_per_sec"],
             "unit": "rounds/s",
             "vs_baseline": round(res["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
@@ -271,7 +271,7 @@ def main() -> None:
             detail["north_star_1000c"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps({
-        "metric": "fl_rounds_per_sec_100c",
+        "metric": metric_name,
         "value": best["rounds_per_sec"],
         "unit": "rounds/s",
         "vs_baseline": round(best["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),

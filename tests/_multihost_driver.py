@@ -57,8 +57,15 @@ def main() -> None:
     state, history = sim.run(save_checkpoints=True, verbose=False)  # auto-disables
     ok_rounds = sum(1 for h in history if h["ok"])
     auc = history[-1].get("roc_auc", float("nan"))
-    print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f}",
-          flush=True)
+
+    # the fused lax.scan fast path must also run SPMD over the DCN mesh
+    import numpy as np
+
+    scan_state, metrics = sim.run_scan(sim.init_state(), 2)
+    scan_ok = int(np.asarray(metrics["ok"]).sum())
+    scan_auc = float(np.asarray(metrics["roc_auc"])[-1])
+    print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f} "
+          f"scan_ok={scan_ok} scan_auc={scan_auc:.4f}", flush=True)
 
 
 if __name__ == "__main__":

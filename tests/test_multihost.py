@@ -49,6 +49,7 @@ def test_two_process_round(tmp_path):
         assert rc == 0, f"process failed (rc={rc}):\n{out}\n{err[-3000:]}"
         assert "MULTIHOST_OK" in out, out
         assert "ok_rounds=1" in out, out
+        assert "scan_ok=2" in out, out  # fused scan path, 2 rounds, SPMD
     # both processes ran the same SPMD program: identical metrics
     lines = [next(l for l in out.splitlines() if "MULTIHOST_OK" in l)
              for _, out, _ in outs]
