@@ -62,3 +62,23 @@ def test_parity_config4_transformer_lie():
     assert np.isfinite(torch_out["final_roc_auc"])
     assert jax_auc > 0.7 and torch_out["final_roc_auc"] > 0.7
     assert abs(jax_auc - torch_out["final_roc_auc"]) < TOL
+
+
+@pytest.mark.slow
+def test_parity_config3_noniid():
+    """BASELINE config 3 (reduced): TransformerModel, 8 clients, Dirichlet
+    non-IID label split — both sides draw from identical per-client pools
+    (same dirichlet_label_partition, same labels/seed)."""
+    cfg = Config(num_round=5, total_clients=8, mode="fedavg",
+                 model="TransformerModel", data_name="ICU", num_data_range=NDR,
+                 epochs=2, batch_size=128, train_size=TRAIN, test_size=TEST,
+                 partition="dirichlet", dirichlet_alpha=0.5,
+                 log_path=".", checkpoint_dir=".")
+    jax_auc = _jax_auc(cfg)
+    torch_out = torch_parity.run(
+        3, clients=8, rounds=5, epochs=2, batch_size=128,
+        num_data_range=NDR, train_size=TRAIN, test_size=TEST,
+        partition="dirichlet", dirichlet_alpha=0.5)
+    assert np.isfinite(torch_out["final_roc_auc"])
+    assert jax_auc > 0.65 and torch_out["final_roc_auc"] > 0.65
+    assert abs(jax_auc - torch_out["final_roc_auc"]) < TOL

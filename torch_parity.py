@@ -16,7 +16,7 @@ backward (the reference clips stale grads, client.py:104-106), and the
 LIE attack deep-copies instead of mutating the leaked models in place
 (Utils.py:209-212).
 
-Usage:  python torch_parity.py --config 1|4 [--clients N] [--rounds R]
+Usage:  python torch_parity.py --config 1|3|4 [--clients N] [--rounds R]
 Prints one JSON line: {"config":…, "final_roc_auc":…, "rounds_per_sec":…}.
 """
 
@@ -191,11 +191,15 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
         batch_size: int = 128, lr: float = 0.004, clip: float = 1.0,
         num_data_range=(12000, 15000), train_size: int = 20000,
         test_size: int = 4000, genuine_rate: float = 0.5, seed: int = 1,
-        attackers: int = 0, lie_z: float = 0.74) -> dict:
+        attackers: int = 0, lie_z: float = 0.74,
+        partition: str = "iid", dirichlet_alpha: float = 0.5) -> dict:
     """Run the reference FL algorithm in torch on the shared synthetic data.
 
-    config_id 1 = CNNModel FedAvg no attack; 4 = TransformerModel FedAvg
-    with LIE attackers (BASELINE.json configs).
+    config_id 1 = CNNModel FedAvg no attack; 3 = TransformerModel FedAvg on
+    a non-IID Dirichlet label split; 4 = TransformerModel FedAvg with LIE
+    attackers (BASELINE.json configs).  The Dirichlet pools come from the
+    same dirichlet_label_partition the JAX side uses (identical
+    labels/seed => identical per-client pools).
     """
     torch.manual_seed(seed)
     random.seed(seed)
@@ -207,6 +211,13 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
     model = TorchCNN() if config_id == 1 else TorchTransformer()
     global_sd = {k: v.clone() for k, v in model.state_dict().items()}
 
+    pools = None
+    if partition == "dirichlet":
+        from attackfl_tpu.data.partition import dirichlet_label_partition
+
+        pools = dirichlet_label_partition(
+            train["label"], clients, dirichlet_alpha, seed=seed)
+
     attacker_ids = set(range(clients - attackers, clients))
     lo, hi = num_data_range
     prev_genuine: list[dict] = []
@@ -217,7 +228,13 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
         new_genuine = []
         for cid in range(clients):
             num_data = rng.integers(lo, hi + 1)
-            idx = rng.choice(train_size, size=min(num_data, train_size), replace=False)
+            if pools is not None:
+                # non-IID: draw from the client's own label pool (with
+                # replacement, mirroring the JAX sampler's pool gather)
+                idx = rng.choice(pools[cid], size=num_data, replace=True)
+            else:
+                idx = rng.choice(train_size, size=min(num_data, train_size),
+                                 replace=False)
             if cid in attacker_ids and prev_genuine:
                 k = max(int(genuine_rate * len(prev_genuine)), 1)
                 sample = [prev_genuine[i] for i in
@@ -255,7 +272,7 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", type=int, default=1, choices=(1, 4))
+    ap.add_argument("--config", type=int, default=1, choices=(1, 3, 4))
     ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--epochs", type=int, default=5)
@@ -264,12 +281,13 @@ def main():
     ap.add_argument("--num-data", type=int, nargs=2, default=None)
     args = ap.parse_args()
     clients = args.clients if args.clients is not None else (3 if args.config == 1 else 100)
-    attackers = 0 if args.config == 1 else max(clients // 4, 1)
+    attackers = max(clients // 4, 1) if args.config == 4 else 0
     ndr = tuple(args.num_data) if args.num_data else (12000, 15000)
     out = run(args.config, clients=clients, rounds=args.rounds,
               epochs=args.epochs, train_size=args.train_size,
               test_size=args.test_size, num_data_range=ndr,
-              attackers=attackers)
+              attackers=attackers,
+              partition="dirichlet" if args.config == 3 else "iid")
     print(json.dumps(out))
 
 
