@@ -109,6 +109,14 @@ class Config:
     genuine_rate: float = 0.5
     random_seed: int = 1
     hyper_detection: HyperDetectionConfig = field(default_factory=HyperDetectionConfig)
+    # Hypernetwork class for mode 'hyper': the generic spec-derived
+    # "HyperNetwork" (reference server.py:800) or the CNNModel-specialized
+    # "CNNHyper" (the commented-out alternative, server.py:801).
+    hyper_class: str = "HyperNetwork"
+    # Spectral normalization on hypernetwork trunk+head kernels
+    # (reference: spec_norm ctor flag, src/Model.py:252,310; always False
+    # where instantiated, server.py:800).
+    hyper_spec_norm: bool = False
     # Label-skew partitioning: "iid" replicates the reference (every client
     # samples uniformly from the shared set, RpcClient.py:166); "dirichlet"
     # gives a non-IID label split with concentration ``dirichlet_alpha``.
@@ -201,6 +209,16 @@ class Config:
         lo, hi = self.num_data_range
         if not (0 < lo <= hi):
             raise ValueError(f"Bad num-data-range {self.num_data_range}")
+        if self.hyper_class not in ("HyperNetwork", "CNNHyper"):
+            raise ValueError(
+                f"Unknown hyper_class {self.hyper_class!r}; choose "
+                "HyperNetwork or CNNHyper"
+            )
+        if self.hyper_class == "CNNHyper" and self.mode == "hyper" and self.model != "CNNModel":
+            raise ValueError(
+                "hyper_class 'CNNHyper' is hand-specialized to CNNModel "
+                f"(src/Model.py:309-416); got model {self.model!r}"
+            )
         if self.mode == "hyper" and self.validation and self.data_name == "HAR":
             # hyper validation exists only for ICU/CIFAR10
             # (reference: Validation.test_hyper, src/Validation.py:138-145)
@@ -278,6 +296,8 @@ def config_from_dict(raw: dict) -> Config:
             min_samples=int(_get(hd, "min_samples", 3)),
             start_round=int(_get(hd, "start-round", 18)),
         ),
+        hyper_class=str(_get(server, "hyper-class", defaults.hyper_class)),
+        hyper_spec_norm=bool(_get(server, "hyper-spec-norm", defaults.hyper_spec_norm)),
         partition=str(_get(server, "partition", defaults.partition)),
         dirichlet_alpha=float(_get(server, "dirichlet-alpha", defaults.dirichlet_alpha)),
         epochs=int(_get(learning, "epoch", defaults.epochs)),

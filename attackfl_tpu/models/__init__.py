@@ -3,5 +3,11 @@ reference class name (registry contract: see attackfl_tpu/registry.py)."""
 
 from attackfl_tpu.models.icu import CNNModel, RNNModel, TransformerModel  # noqa: F401
 from attackfl_tpu.models.har import TransformerClassifier  # noqa: F401
-from attackfl_tpu.models.hyper import HyperNetwork, make_hypernetwork, target_spec  # noqa: F401
+from attackfl_tpu.models.hyper import (  # noqa: F401
+    CNNHyper,
+    HyperNetwork,
+    make_cnn_hyper,
+    make_hypernetwork,
+    target_spec,
+)
 from attackfl_tpu.models.resnet import ResNet18  # noqa: F401

@@ -27,6 +27,70 @@ import jax.numpy as jnp
 from attackfl_tpu.ops.pytree import path_name
 
 
+def spectral_normalize(kernel: jnp.ndarray, n_iter: int = 15) -> jnp.ndarray:
+    """Divide ``kernel`` by (an estimate of) its largest singular value.
+
+    Stateless TPU-friendly redesign of ``torch.nn.utils.spectral_norm``:
+    torch amortizes one power-iteration step per forward through a
+    persistent ``u`` buffer; under jit that mutable buffer would be a
+    second variable collection threaded through every vjp/optimizer path,
+    so instead we run ``n_iter`` power iterations from a fixed start
+    vector inside the forward — a few tiny matvecs, fully fused by XLA.
+    Like torch, ``u``/``v`` are treated as constants for autodiff
+    (stop_gradient); gradients flow through ``kernel / sigma``.
+    """
+    w = kernel.reshape(-1, kernel.shape[-1])  # (fan_in, fan_out)
+
+    def body(_, uv):
+        u, _v = uv
+        v = w @ u
+        v = v / (jnp.linalg.norm(v) + 1e-12)
+        u = w.T @ v
+        u = u / (jnp.linalg.norm(u) + 1e-12)
+        return u, v
+
+    u0 = jnp.full((w.shape[1],), 1.0 / math.sqrt(w.shape[1]), dtype=w.dtype)
+    v0 = jnp.zeros((w.shape[0],), dtype=w.dtype)
+    u, v = jax.lax.fori_loop(0, n_iter, body, (u0, v0))
+    u, v = jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+    sigma = v @ (w @ u)
+    return kernel / (sigma + 1e-12)
+
+
+class SNDense(nn.Module):
+    """Dense layer whose kernel is spectrally normalized at application
+    time (the rebuild's ``nn.utils.spectral_norm(nn.Linear(...))``,
+    reference src/Model.py:258-262,328-332)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,))
+        return x @ spectral_normalize(kernel) + bias
+
+
+def _dense(spec_norm: bool, features: int, name: str):
+    return (SNDense if spec_norm else nn.Dense)(features, name=name)
+
+
+def _trunk(m, idx: jnp.ndarray) -> jnp.ndarray:
+    """Shared embed->MLP trunk (reference src/Model.py:255-265,313-327):
+    client index -> (embedding, features).  ``m`` is a HyperNetwork or
+    CNNHyper instance inside @nn.compact — identical parameter naming in
+    both keeps their checkpoints head-for-head comparable."""
+    emd = nn.Embed(m.n_nodes, m.embedding_dim, name="embeddings")(idx)
+    f = _dense(m.spec_norm, m.hidden_dim, "mlp_in")(emd)
+    for i in range(m.n_hidden):
+        f = _dense(m.spec_norm, m.hidden_dim, f"mlp_hidden{i}")(nn.relu(f))
+    return emd, f
+
+
 def target_spec(template_params: Any) -> tuple[tuple[str, tuple[int, ...]], ...]:
     """Hashable (name, shape) spec for every leaf of a target param pytree.
 
@@ -56,21 +120,12 @@ class HyperNetwork(nn.Module):
 
     @nn.compact
     def __call__(self, idx: jnp.ndarray):
-        if self.spec_norm:
-            raise NotImplementedError(
-                "spectral-norm hypernetwork heads are not implemented; the "
-                "reference always instantiates with spec_norm=False "
-                "(server.py:800)"
-            )
-        emd = nn.Embed(self.n_nodes, self.embedding_dim, name="embeddings")(idx)
-        f = nn.Dense(self.hidden_dim, name="mlp_in")(emd)
-        for i in range(self.n_hidden):
-            f = nn.Dense(self.hidden_dim, name=f"mlp_hidden{i}")(nn.relu(f))
+        emd, f = _trunk(self, idx)
 
         outputs: dict[str, jnp.ndarray] = {}
         for name, shape in self.spec:
             numel = math.prod(shape) if shape else 1
-            out = nn.Dense(numel, name=f"head_{name}")(f)
+            out = _dense(self.spec_norm, numel, f"head_{name}")(f)
             outputs[name] = out.reshape(shape)
         return outputs, emd
 
@@ -105,5 +160,117 @@ def make_hypernetwork(
         flat, emd = module.apply({"params": hparams}, idx)
         params = jax.tree.unflatten(treedef, [flat[n] for n in names])
         return params, emd
+
+    return module, apply_fn
+
+
+# (head name, CNNModel leaf path, Flax-layout shape).  Hand-inlined for the
+# CNNModel architecture exactly as the reference hand-writes one Linear
+# head per layer (src/Model.py:328-356,389-414); shapes are the Flax
+# layouts (Conv kernel (k, in, out), Dense kernel (in, out)) of the torch
+# shapes the reference .view()s to (e.g. fc1 128x1024 <-> (1024, 128)).
+_CNN_HYPER_HEADS: tuple[tuple[str, str, tuple[int, ...]], ...] = tuple(
+    head
+    for branch in ("vitals", "labs")
+    for head in (
+        (f"{branch}_conv1_weights", f"{branch}_conv1/kernel", (3, 1, 32)),
+        (f"{branch}_conv1_bias", f"{branch}_conv1/bias", (32,)),
+        (f"{branch}_conv2_weights", f"{branch}_conv2/kernel", (3, 32, 64)),
+        (f"{branch}_conv2_bias", f"{branch}_conv2/bias", (64,)),
+        (f"{branch}_conv3_weights", f"{branch}_conv3/kernel", (3, 64, 128)),
+        (f"{branch}_conv3_bias", f"{branch}_conv3/bias", (128,)),
+    )
+) + (
+    ("fc1_weights", "fc1/kernel", (128 * 2 * 4, 128)),
+    ("fc1_bias", "fc1/bias", (128,)),
+    ("fc2_weights", "fc2/kernel", (128, 64)),
+    ("fc2_bias", "fc2/bias", (64,)),
+    ("fc3_weights", "fc3/kernel", (64, 32)),
+    ("fc3_bias", "fc3/bias", (32,)),
+    ("output_weights", "output/kernel", (32, 1)),
+    ("output_bias", "output/bias", (1,)),
+)
+
+
+class CNNHyper(nn.Module):
+    """Hypernetwork hand-specialized to CNNModel (reference: CNNHyper,
+    src/Model.py:309-416, the commented-out alternative at server.py:801).
+
+    Same embedding -> MLP trunk as HyperNetwork but with one explicitly
+    named head per CNNModel layer instead of spec-derived heads, and with
+    spectral normalization applicable to trunk *and* heads
+    (src/Model.py:359-381).  ``__call__(idx)`` returns
+    ``(params pytree in CNNModel layout, embedding)``.
+    """
+
+    n_nodes: int
+    embedding_dim: int = 8
+    hidden_dim: int = 100
+    spec_norm: bool = False
+    n_hidden: int = 2
+
+    @nn.compact
+    def __call__(self, idx: jnp.ndarray):
+        emd, f = _trunk(self, idx)
+
+        params: dict[str, dict[str, jnp.ndarray]] = {}
+        for head_name, path, shape in _CNN_HYPER_HEADS:
+            module_name, param_name = path.split("/")
+            out = _dense(self.spec_norm, math.prod(shape), head_name)(f)
+            params.setdefault(module_name, {})[param_name] = out.reshape(shape)
+        return params, emd
+
+
+def make_cnn_hyper(
+    template_params: Any,
+    n_nodes: int,
+    embedding_dim: int = 8,
+    hidden_dim: int = 100,
+    spec_norm: bool = False,
+    n_hidden: int = 2,
+) -> tuple[CNNHyper, Callable]:
+    """Build a CNNHyper for a CNNModel param pytree; same
+    ``(module, apply_fn)`` contract as :func:`make_hypernetwork` so the
+    hyper-mode engine can use either interchangeably.
+
+    Raises if ``template_params`` is not the CNNModel layout the heads are
+    hand-written for (the reference analog would produce mis-shaped
+    state_dicts silently).
+    """
+    expected = {path: shape for _, path, shape in _CNN_HYPER_HEADS}
+    actual = {
+        path_name(p): tuple(leaf.shape)
+        for p, leaf in jax.tree_util.tree_flatten_with_path(template_params)[0]
+    }
+    if actual != expected:
+        diff = {
+            path: (actual.get(path), expected.get(path))
+            for path in sorted(set(actual) | set(expected))
+            if actual.get(path) != expected.get(path)
+        }
+        raise ValueError(
+            "CNNHyper targets the CNNModel parameter layout only; "
+            f"mismatched leaves (got, expected): {diff}"
+        )
+
+    module = CNNHyper(
+        n_nodes=n_nodes,
+        embedding_dim=embedding_dim,
+        hidden_dim=hidden_dim,
+        spec_norm=spec_norm,
+        n_hidden=n_hidden,
+    )
+    treedef = jax.tree.structure(template_params)
+    leaf_paths = [
+        path_name(p).split("/")
+        for p, _ in jax.tree_util.tree_flatten_with_path(template_params)[0]
+    ]
+
+    def apply_fn(hparams, idx):
+        nested, emd = module.apply({"params": hparams}, idx)
+        # rebuild through the template treedef so downstream pytree ops see
+        # *exactly* the target structure (incl. dict ordering / FrozenDict)
+        leaves = [nested[mod][param] for mod, param in leaf_paths]
+        return jax.tree.unflatten(treedef, leaves), emd
 
     return module, apply_fn

@@ -27,7 +27,7 @@ from attackfl_tpu.config import Config
 from attackfl_tpu.data.partition import dirichlet_label_partition
 from attackfl_tpu.data.synthetic import get_dataset
 from attackfl_tpu.eval.validation import Validation
-from attackfl_tpu.models.hyper import make_hypernetwork
+from attackfl_tpu.models.hyper import make_cnn_hyper, make_hypernetwork
 from attackfl_tpu.ops import defenses
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.parallel.mesh import (
@@ -135,9 +135,11 @@ class Simulator:
             init_rng = jax.random.key(cfg.random_seed, impl=cfg.prng_impl)
             template = self.model.init(init_rng, *sample_inputs(cfg.data_name))["params"]
             self.target_template = template
-            self.hnet, self.hnet_apply = make_hypernetwork(
+            make_hnet = (make_cnn_hyper if cfg.hyper_class == "CNNHyper"
+                         else make_hypernetwork)
+            self.hnet, self.hnet_apply = make_hnet(
                 template, cfg.total_clients, embedding_dim=8, hidden_dim=100,
-                spec_norm=False, n_hidden=2,
+                spec_norm=cfg.hyper_spec_norm, n_hidden=2,
             )
             round_step, generate_all = build_hyper_round(
                 self.model, cfg, self.train_data, self.attack_groups,

@@ -125,3 +125,17 @@ def test_hyper_mode_with_detection():
     state, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
     assert all(h["ok"] for h in hist)
     assert "roc_auc" in hist[-1]
+
+
+def test_hyper_mode_cnn_hyper():
+    """hyper mode with the CNNModel-specialized CNNHyper (the reference's
+    commented-out alternative, server.py:801) trains end-to-end."""
+    cfg = Config(
+        num_round=2, total_clients=3, mode="hyper", model="CNNModel",
+        hyper_class="CNNHyper", data_name="ICU", num_data_range=(48, 64),
+        epochs=1, batch_size=32, train_size=256, test_size=128,
+        log_path=".", checkpoint_dir=".",
+    )
+    state, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    assert "roc_auc" in hist[-1]
