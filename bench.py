@@ -4,9 +4,9 @@ Default invocation (the driver's) measures the headline workload —
 BASELINE.json config 4: ICU TransformerModel, 100 clients, FedAvg, 20 LIE
 attackers at genuine-rate 0.5, full reference hyperparameters (5 local
 epochs, batch 128, 12k-15k samples/client/round — config.yaml:17-20,31-37),
-validation on — on BOTH local-training backends (xla and the Pallas fused
-kernel) when running on TPU, and additionally runs the north-star-scale
-1000-client workload.
+validation on — on every local-training variant (xla f32, xla bf16
+compute, and the Pallas fused kernel) when running on TPU, and
+additionally runs the north-star-scale 1000-client workload.
 
 Prints ONE JSON line:
   {"metric": "fl_rounds_per_sec_100c", "value": N, "unit": "rounds/s",
@@ -29,6 +29,7 @@ the workload (VERDICT round-2 next-steps #1/#2).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import time
 
@@ -121,6 +122,11 @@ def tpu_init_watchdog(metric: str, seconds: float = 600.0):
     return cancel
 
 
+def _with_dtype(cfg, dtype: str):
+    """Override mesh.compute-dtype (nested frozen dataclass)."""
+    return cfg.replace(mesh=dataclasses.replace(cfg.mesh, compute_dtype=dtype))
+
+
 def north_star_config(log_path: str = "/tmp/attackfl_bench"):
     """The BASELINE.json north-star workload: 1000 clients, 20% LIE
     attackers, full reference hyperparameters (single source of truth —
@@ -191,6 +197,9 @@ def main() -> None:
     parser.add_argument("--config", type=int, default=None,
                         help="single BASELINE config 1-5 (default: headline suite)")
     parser.add_argument("--backend", choices=["xla", "pallas"], default=None)
+    parser.add_argument("--dtype", choices=["float32", "bfloat16"], default=None,
+                        help="compute dtype for the xla local-training "
+                             "backend (mesh.compute-dtype)")
     parser.add_argument("--clients", type=int, default=None)
     parser.add_argument("--rounds", type=int, default=4,
                         help="timed rounds per measurement")
@@ -200,9 +209,10 @@ def main() -> None:
                              "section into this directory (single-row mode)")
     args = parser.parse_args()
 
-    if args.config is None and (args.backend or args.clients or args.trace):
-        parser.error("--backend/--clients/--trace apply to a single row; "
-                     "add --config N")
+    if args.config is None and (args.backend or args.clients or args.trace
+                                or args.dtype):
+        parser.error("--backend/--clients/--dtype/--trace apply to a single "
+                     "row; add --config N")
 
     metric_name = ("fl_rounds_per_sec_100c" if args.config is None
                    else f"fl_rounds_per_sec_config{args.config}")
@@ -219,6 +229,8 @@ def main() -> None:
             cfg = cfg.replace(total_clients=args.clients)
         if args.backend:
             cfg = cfg.replace(local_backend=args.backend)
+        if args.dtype:
+            cfg = _with_dtype(cfg, args.dtype)
         res = measure(cfg, args.rounds, trace_dir=args.trace)
         print(json.dumps({
             "metric": metric_name,
@@ -242,6 +254,13 @@ def main() -> None:
     cfg4 = make_config(4)
     results["xla"] = measure(cfg4, args.rounds)
     if on_tpu:
+        # bf16 local training rides the MXU's native dtype
+        # (mesh.compute-dtype; master weights/Adam stay f32 — local.py)
+        try:
+            results["xla_bf16"] = measure(
+                _with_dtype(cfg4, "bfloat16"), args.rounds)
+        except Exception as e:  # noqa: BLE001
+            results["xla_bf16"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         # the Pallas fused kernel is TPU-only (interpret mode is a CPU
         # correctness path, not a perf path — ops/fused_step.py)
         try:
@@ -260,10 +279,17 @@ def main() -> None:
     detail["seconds_per_round"] = best["seconds_per_round"]
 
     # north star is a TPU-scale workload (1000 clients, full reference
-    # hyperparameters) — off-TPU it would grind a CPU box for hours
+    # hyperparameters) — off-TPU it would grind a CPU box for hours.
+    # It rides whichever backend variant won the 100-client comparison.
     if not args.skip_north_star and on_tpu:
         try:
-            ns = measure(north_star_config(), 2)
+            ns_cfg = north_star_config()
+            if best_name == "pallas":
+                ns_cfg = ns_cfg.replace(local_backend="pallas")
+            elif best_name == "xla_bf16":
+                ns_cfg = _with_dtype(ns_cfg, "bfloat16")
+            ns = measure(ns_cfg, 2)
+            ns["backend"] = best_name
             ns["vs_north_star"] = round(
                 ns["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4)
             detail["north_star_1000c"] = ns

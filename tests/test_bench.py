@@ -1,0 +1,55 @@
+"""bench.py is the driver's measurement entry point — keep it importable,
+its BASELINE configs constructible, and measure() functional at toy scale
+(the full-scale numbers themselves are TPU work, BASELINE.md)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import bench
+from attackfl_tpu.config import AttackSpec
+
+
+def test_make_config_all_rows_construct():
+    """Configs 1-5 (BASELINE.md table) pass Config cross-validation."""
+    for n in range(1, 6):
+        cfg = bench.make_config(n)
+        assert cfg.total_clients >= 3
+    with pytest.raises(ValueError):
+        bench.make_config(6)
+
+
+def test_north_star_geometry():
+    cfg = bench.north_star_config()
+    assert cfg.total_clients == 1000
+    assert sum(a.num_clients for a in cfg.attacks) == 200  # 20% LIE
+
+
+def test_measure_fused_and_host_paths(tmp_path):
+    """measure() returns rounds/s + final metric on both code paths
+    (fused scan vs per-round host loop)."""
+    tiny = dict(num_data_range=(48, 64), epochs=1, batch_size=32,
+                train_size=256, test_size=128, log_path=str(tmp_path))
+    cfg = bench.make_config(1).replace(num_round=2, **tiny)
+    res = bench.measure(cfg, 2)
+    assert res["rounds_per_sec"] > 0 and "roc_auc" in res
+    # gmm filters on host -> run_round path
+    cfg_host = cfg.replace(mode="gmm", attacks=(
+        AttackSpec(mode="Random", num_clients=1, attack_round=1,
+                   args=(1.0,)),))
+    res2 = bench.measure(cfg_host, 2)
+    assert res2["rounds_per_sec"] > 0
+
+
+def test_cli_flag_validation():
+    """--backend/--clients without --config is a usage error (exit 2),
+    cheap enough to check in-process via a subprocess."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--backend", "pallas"],
+        capture_output=True, text=True,
+        cwd=pathlib.Path(bench.__file__).parent,
+    )
+    assert proc.returncode == 2
+    assert "--config" in proc.stderr
