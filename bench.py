@@ -203,25 +203,117 @@ def main() -> None:
     parser.add_argument("--clients", type=int, default=None)
     parser.add_argument("--rounds", type=int, default=4,
                         help="timed rounds per measurement")
+    parser.add_argument("--north-star", action="store_true",
+                        help="measure ONLY the 1000-client north-star row")
+    parser.add_argument("--e2e-rounds", type=int, default=None, metavar="N",
+                        help="measure ONLY an N-round end-to-end run_fast "
+                             "(compile + run) of the headline config")
     parser.add_argument("--skip-north-star", action="store_true")
+    parser.add_argument("--deadline", type=float, default=2400.0,
+                        help="whole-run wall-clock budget (s); on expiry the "
+                             "bench prints best-so-far JSON and exits 3 "
+                             "instead of hanging on a wedged TPU dispatch")
     parser.add_argument("--trace", type=str, default=None,
                         help="capture a jax.profiler trace of the timed "
                              "section into this directory (single-row mode)")
     args = parser.parse_args()
 
-    if args.config is None and (args.backend or args.clients or args.trace
-                                or args.dtype):
+    if sum(map(bool, (args.config is not None, args.north_star,
+                      args.e2e_rounds is not None))) > 1:
+        parser.error("--config / --north-star / --e2e-rounds are exclusive")
+    single = (args.config is not None or args.north_star
+              or args.e2e_rounds is not None)
+    if not single and (args.backend or args.clients or args.trace or args.dtype):
         parser.error("--backend/--clients/--dtype/--trace apply to a single "
-                     "row; add --config N")
+                     "measurement; add --config N / --north-star / --e2e-rounds")
+    if args.clients and args.config is None:
+        parser.error("--clients applies to --config rows")
+    if args.e2e_rounds is not None and args.backend:
+        parser.error("--e2e-rounds measures the xla run_fast path; --backend "
+                     "does not apply")
 
-    metric_name = ("fl_rounds_per_sec_100c" if args.config is None
-                   else f"fl_rounds_per_sec_config{args.config}")
+    if args.north_star:
+        metric_name = "fl_rounds_per_sec_1000c"
+    elif args.e2e_rounds is not None:
+        metric_name = f"fl_e2e_{args.e2e_rounds}_rounds_per_sec"
+    elif args.config is not None:
+        metric_name = f"fl_rounds_per_sec_config{args.config}"
+    else:
+        metric_name = "fl_rounds_per_sec_100c"
     cancel_watchdog = tpu_init_watchdog(metric_name)
+
+    # Whole-run deadline: a TPU dispatch can wedge indefinitely when the
+    # axon tunnel drops mid-run (observed: blocked in an RPC that neither
+    # returns nor delivers SIGINT).  Emit whatever was measured so the
+    # driver still records a JSON line.
+    partial: dict = {}
+
+    def _deadline():
+        import os
+        best = [(k, v["rounds_per_sec"]) for k, v in
+                partial.get("backends_100c", {}).items()
+                if isinstance(v, dict) and "rounds_per_sec" in v]
+        value = max((r for _, r in best), default=0.0)
+        print(json.dumps({
+            "metric": metric_name, "value": value, "unit": "rounds/s",
+            "vs_baseline": round(value / NORTH_STAR_ROUNDS_PER_SEC, 4),
+            "detail": {**partial,
+                       "error": f"deadline {args.deadline:.0f}s expired "
+                                "(TPU dispatch wedged?); partial results"},
+        }), flush=True)
+        os._exit(3)
+
+    import threading
+
+    deadline_timer = threading.Timer(args.deadline, _deadline)
+    deadline_timer.daemon = True
+    deadline_timer.start()
 
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
     cancel_watchdog()
+
+    def finish(res: dict, value_key: str = "rounds_per_sec") -> None:
+        deadline_timer.cancel()
+        print(json.dumps({
+            "metric": metric_name,
+            "value": res[value_key],
+            "unit": "rounds/s",
+            "vs_baseline": round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4),
+            "detail": res,
+        }))
+
+    if args.north_star:  # 1000-client row (BASELINE.json target workload)
+        cfg = north_star_config()
+        if args.backend:
+            cfg = cfg.replace(local_backend=args.backend)
+        if args.dtype:
+            cfg = _with_dtype(cfg, args.dtype)
+        res = measure(cfg, 2, trace_dir=args.trace)
+        res["vs_north_star"] = round(
+            res["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4)
+        finish(res)
+        return
+
+    if args.e2e_rounds is not None:  # full run incl. compile (VERDICT r2 #4)
+        from attackfl_tpu.training.engine import Simulator
+
+        cfg = make_config(4).replace(num_round=args.e2e_rounds)
+        if args.dtype:
+            cfg = _with_dtype(cfg, args.dtype)
+        sim = Simulator(cfg)
+        t0 = time.time()
+        _, hist = sim.run_fast(save_checkpoints=False, verbose=False)
+        total = time.time() - t0
+        ok = sum(1 for h in hist if h["ok"])
+        res = {"total_s": round(total, 1), "ok_rounds": ok,
+               "rounds_per_sec_incl_compile": round(ok / total, 4)}
+        auc = hist[-1].get("roc_auc")
+        if auc is not None and auc == auc:  # NaN-guard: keep JSON strict
+            res["roc_auc_final"] = round(auc, 4)
+        finish(res, value_key="rounds_per_sec_incl_compile")
+        return
 
     if args.config is not None:  # single-row mode (BASELINE.md table filling)
         cfg = make_config(args.config)
@@ -232,13 +324,7 @@ def main() -> None:
         if args.dtype:
             cfg = _with_dtype(cfg, args.dtype)
         res = measure(cfg, args.rounds, trace_dir=args.trace)
-        print(json.dumps({
-            "metric": metric_name,
-            "value": res["rounds_per_sec"],
-            "unit": "rounds/s",
-            "vs_baseline": round(res["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
-            "detail": res,
-        }))
+        finish(res)
         return
 
     # ---- headline suite (driver default) --------------------------------
@@ -251,6 +337,8 @@ def main() -> None:
         ),
     }
     results = {}
+    partial.update(detail)
+    partial["backends_100c"] = results
     cfg4 = make_config(4)
     results["xla"] = measure(cfg4, args.rounds)
     if on_tpu:
@@ -296,6 +384,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             detail["north_star_1000c"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    deadline_timer.cancel()
     print(json.dumps({
         "metric": metric_name,
         "value": best["rounds_per_sec"],

@@ -1,14 +1,22 @@
 """Fill BASELINE.md's table: measure every BASELINE config on this chip.
 
-Runs (TPU expected; CPU works but is not the target):
-  1. configs 1-5 via bench.make_config / bench.measure
-  2. the headline config on both local-training backends (xla vs pallas)
-  3. the 1000-client north-star workload
-  4. a full 100-round TransformerModel run end-to-end (compile + run),
-     the VERDICT round-2 item #4 measurement
+Each measurement runs in its OWN subprocess with its own timeout: the axon
+TPU tunnel can wedge a dispatch indefinitely (blocked in an RPC that never
+returns and swallows SIGINT), and in-process sequencing would lose every
+row after the first wedge.  Children are ``bench.py`` invocations, so every
+row gets bench's init watchdog and ``--deadline`` best-effort-JSON path
+(set below the step timeout so partial results survive a wedge).  Rows:
+
+  1. configs 1-5 via ``bench.py --config N``
+  2. the headline config on the xla-bf16 and pallas local-training variants
+  3. the 1000-client north star via ``bench.py --north-star``
+  4. a full 100-round end-to-end run via ``bench.py --e2e-rounds 100``
+
+Off-TPU the pallas and north-star steps are auto-skipped (interpret-mode
+pallas and 1000 clients would grind a CPU box for hours).
 
 Usage: python scripts/measure_baseline.py [--rounds 4] [--out /tmp/baseline_rows.json]
-Prints one JSON object per measurement line; the final line is the
+Prints one JSON object per measurement as it lands; the final line is the
 aggregate dict (also written to --out).
 """
 
@@ -16,81 +24,86 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+REPO = Path(__file__).resolve().parent.parent
 
-import bench  # noqa: E402
+PROBE_SNIPPET = """
+import json, bench
+cancel = bench.tpu_init_watchdog("probe")
+import jax
+row = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+cancel()
+print(json.dumps(row))
+"""
+
+
+def run_step(argv: list[str], timeout_s: float) -> dict:
+    """Run one measurement subprocess; parse its last JSON stdout line."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s (TPU dispatch wedged?)",
+                "wall_s": round(time.time() - t0, 1)}
+    wall = round(time.time() - t0, 1)
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if proc.returncode not in (0, 3) or not lines:
+        tail = (proc.stderr or proc.stdout)[-400:]
+        return {"error": f"rc={proc.returncode}: {tail}", "wall_s": wall}
+    try:
+        row = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        return {"error": f"unparseable output ({e}): {lines[-1][:200]}",
+                "wall_s": wall}
+    row = row.get("detail", row) if "metric" in row else row
+    row["wall_s"] = wall
+    if proc.returncode == 3:
+        row.setdefault("error", "bench deadline expired; partial results")
+    return row
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=4)
     parser.add_argument("--out", type=str, default="/tmp/baseline_rows.json")
+    parser.add_argument("--step-timeout", type=float, default=1500.0)
     parser.add_argument("--skip", type=str, default="",
                         help="comma-separated step names to skip")
     args = parser.parse_args()
     skip = set(args.skip.split(",")) if args.skip else set()
 
-    cancel_watchdog = bench.tpu_init_watchdog("baseline_table")
+    py = sys.executable
+    # child deadline below the kill timeout so a wedged child still emits
+    # best-so-far JSON (exit 3) before subprocess.run gives up on it
+    deadline = str(max(args.step_timeout - 120.0, 60.0))
+    bench_row = lambda *extra: [py, "bench.py", "--rounds", str(args.rounds),  # noqa: E731
+                                "--deadline", deadline, *extra]
 
-    import jax
-
-    from attackfl_tpu.training.engine import Simulator
-
-    out: dict = {"backend": jax.default_backend(),
-                 "device": str(jax.devices()[0])}
-    cancel_watchdog()
-    if jax.default_backend() != "tpu":
-        # same guards as bench.main: pallas off-TPU is interpret mode (a
-        # correctness path that would grind for hours at bench scale) and
-        # the 1000-client north star is a TPU-scale workload
+    out: dict = {"probe": run_step([py, "-c", PROBE_SNIPPET], 660.0)}
+    print(json.dumps({"probe": out["probe"]}), flush=True)
+    if out["probe"].get("backend") != "tpu":
         skip |= {"config4_pallas", "north_star_1000c"}
         out["note"] = "off-TPU: pallas + north-star steps auto-skipped"
 
-    def record(name, fn):
+    steps: list[tuple[str, list[str]]] = [
+        *[(f"config{n}", bench_row("--config", str(n))) for n in range(1, 6)],
+        ("config4_bf16", bench_row("--config", "4", "--dtype", "bfloat16")),
+        ("config4_pallas", bench_row("--config", "4", "--backend", "pallas")),
+        ("north_star_1000c", bench_row("--north-star")),
+        ("run_100_rounds_e2e", bench_row("--e2e-rounds", "100")),
+    ]
+
+    for name, argv in steps:
         if name in skip:
-            return
-        t0 = time.time()
-        try:
-            out[name] = fn()
-        except Exception as e:  # noqa: BLE001 — keep measuring other rows
-            out[name] = {"error": f"{type(e).__name__}: {e}"[:400]}
-        out[name]["wall_s"] = round(time.time() - t0, 1)
+            continue
+        out[name] = run_step(argv, args.step_timeout)
         print(json.dumps({name: out[name]}), flush=True)
-
-    for n in range(1, 6):
-        record(f"config{n}", lambda n=n: bench.measure(
-            bench.make_config(n), args.rounds))
-
-    record("config4_pallas", lambda: bench.measure(
-        bench.make_config(4).replace(local_backend="pallas"), args.rounds))
-
-    def north_star():
-        res = bench.measure(bench.north_star_config(), 2)
-        res["vs_north_star"] = round(
-            res["rounds_per_sec"] / bench.NORTH_STAR_ROUNDS_PER_SEC, 4)
-        return res
-
-    record("north_star_1000c", north_star)
-
-    def hundred_rounds():
-        cfg = bench.make_config(4).replace(num_round=100)
-        sim = Simulator(cfg)
-        t0 = time.time()
-        state, hist = sim.run_fast(save_checkpoints=False, verbose=False)
-        total = time.time() - t0
-        ok = sum(1 for h in hist if h["ok"])
-        row = {"total_s": round(total, 1), "ok_rounds": ok,
-               "rounds_per_sec_incl_compile": round(ok / total, 4)}
-        auc = hist[-1].get("roc_auc")
-        if auc is not None and auc == auc:  # NaN-guard: keep JSON strict
-            row["roc_auc_final"] = round(auc, 4)
-        return row
-
-    record("run_100_rounds_e2e", hundred_rounds)
 
     Path(args.out).write_text(json.dumps(out, indent=1))
     print(json.dumps(out))

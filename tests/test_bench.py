@@ -43,6 +43,55 @@ def test_measure_fused_and_host_paths(tmp_path):
     assert res2["rounds_per_sec"] > 0
 
 
+def test_mode_exclusivity(monkeypatch):
+    for argv in (["bench.py", "--config", "1", "--north-star"],
+                 ["bench.py", "--north-star", "--e2e-rounds", "5"],
+                 ["bench.py", "--clients", "8"],
+                 ["bench.py", "--e2e-rounds", "5", "--backend", "pallas"]):
+        monkeypatch.setattr(sys, "argv", argv)
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2, argv
+
+
+def test_e2e_rounds_mode(monkeypatch, capsys, tmp_path):
+    """--e2e-rounds measures a full run_fast (compile + run) and reports
+    rounds/s including compile — the north-star-shaped compile-cost row."""
+    import json
+
+    orig = bench.make_config
+    monkeypatch.setattr(bench, "make_config", lambda n, log_path=str(tmp_path):
+                        orig(n, log_path).replace(
+                            num_data_range=(48, 64), epochs=1, batch_size=32,
+                            train_size=256, test_size=128, total_clients=4,
+                            attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                                attack_round=2, args=(0.74,)),)))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--e2e-rounds", "3"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "fl_e2e_3_rounds_per_sec"
+    assert out["detail"]["ok_rounds"] == 3
+    assert out["value"] > 0
+
+
+@pytest.mark.slow
+def test_deadline_emits_json_and_exit_3():
+    """--deadline must guarantee the driver a JSON line even when a TPU
+    dispatch (or backend init) wedges: exit 3 with best-so-far detail."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--config", "1", "--rounds", "1",
+         "--deadline", "10"],
+        capture_output=True, text=True, timeout=300,
+        cwd=pathlib.Path(bench.__file__).parent,
+    )
+    assert proc.returncode == 3, proc.stderr[-500:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    out = json.loads(line)
+    assert "error" in out["detail"] and out["unit"] == "rounds/s"
+
+
 def test_cli_flag_validation():
     """--backend/--clients without --config is a usage error (exit 2),
     cheap enough to check in-process via a subprocess."""
