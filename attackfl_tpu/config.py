@@ -117,6 +117,17 @@ class Config:
     # (reference: spec_norm ctor flag, src/Model.py:252,310; always False
     # where instantiated, server.py:800).
     hyper_spec_norm: bool = False
+    # Straggler/dropout fault injection (SURVEY.md §5): each round every
+    # client independently fails to report with this probability.  A
+    # dropped client contributes no update that round: size-weighted
+    # aggregators exclude it exactly (its round size is 0), geometric
+    # aggregators (median/krum/trimmed-mean/shieldfl) see an unchanged
+    # replica, in hyper mode its hnet step is skipped, and its last
+    # REPORTED update stays (stale) in the genuine-leak pool.  The
+    # reference has no dropout handling at all — its round barrier waits
+    # forever on a silent client (server.py:271-272); here a round where
+    # EVERY client drops fails and retries like any failed round.
+    client_dropout_rate: float = 0.0
     # Label-skew partitioning: "iid" replicates the reference (every client
     # samples uniformly from the shared set, RpcClient.py:166); "dirichlet"
     # gives a non-IID label split with concentration ``dirichlet_alpha``.
@@ -209,6 +220,12 @@ class Config:
         lo, hi = self.num_data_range
         if not (0 < lo <= hi):
             raise ValueError(f"Bad num-data-range {self.num_data_range}")
+        if not (0.0 <= self.client_dropout_rate < 1.0):
+            raise ValueError(
+                f"client_dropout_rate must be in [0, 1), got "
+                f"{self.client_dropout_rate} (1.0 would drop every client "
+                "every round; the reference analog is a barrier deadlock)"
+            )
         if self.hyper_class not in ("HyperNetwork", "CNNHyper"):
             raise ValueError(
                 f"Unknown hyper_class {self.hyper_class!r}; choose "
@@ -296,6 +313,8 @@ def config_from_dict(raw: dict) -> Config:
             min_samples=int(_get(hd, "min_samples", 3)),
             start_round=int(_get(hd, "start-round", 18)),
         ),
+        client_dropout_rate=float(_get(server, "client-dropout-rate",
+                                       defaults.client_dropout_rate)),
         hyper_class=str(_get(server, "hyper-class", defaults.hyper_class)),
         hyper_spec_norm=bool(_get(server, "hyper-spec-norm", defaults.hyper_spec_norm)),
         partition=str(_get(server, "partition", defaults.partition)),

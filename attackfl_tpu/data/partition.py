@@ -51,6 +51,21 @@ def sample_round_indices(
     return idx.astype(jnp.int32), mask, sizes.astype(jnp.int32)
 
 
+def apply_client_dropout(
+    k_drop: jax.Array,
+    sizes: jnp.ndarray,
+    mask: jnp.ndarray,
+    rate: float,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Straggler injection (cfg.client_dropout_rate), shared by the plain
+    and hyper round builders: each client independently drops with
+    probability ``rate``; a dropped client gets zero samples (all-masked
+    batches → exact local-update no-op) and round size 0 (exact exclusion
+    from size-weighted aggregation).  Returns ``(sizes, mask, kept)``."""
+    kept = jax.random.bernoulli(k_drop, 1.0 - rate, sizes.shape)
+    return sizes * kept, mask & kept[:, None], kept
+
+
 def dirichlet_label_partition(
     labels: np.ndarray,
     num_clients: int,

@@ -316,6 +316,13 @@ class Simulator:
                 ok = False
             weights_mask = jnp.asarray(mask)
 
+        # defense filter ∩ reporting clients: with dropout on, the defense
+        # can keep only dropped (size-0) clients — then no weight remains
+        # and a weighted average would be 0/0; fail the round instead
+        weights_mask = weights_mask * (sizes > 0)
+        if ok and not bool(jnp.any(weights_mask > 0)):
+            ok = False
+
         new_global = state["global_params"]
         if ok:
             with timer.phase("aggregate"):
@@ -369,7 +376,8 @@ class Simulator:
         if ok:
             with timer.phase("hyper_update"):
                 hnet_params, opt_state = self.hyper_update(
-                    hnet_params, opt_state, stacked, active_mask
+                    # dropped clients (size 0) skip their hnet step
+                    hnet_params, opt_state, stacked, active_mask * (sizes > 0)
                 )
                 jax.block_until_ready(hnet_params)
 
@@ -467,7 +475,9 @@ class Simulator:
                 )
                 new_hp, new_opt = hyper_update(
                     state["hnet_params"], state["hyper_opt_state"],
-                    stacked, active_mask,
+                    # dropped clients (size 0) skip their hnet step — the
+                    # reference iterates only reporting clients
+                    stacked, active_mask * (sizes > 0),
                 )
                 ok = train_ok
                 metrics = {"train_loss": loss}
@@ -507,7 +517,8 @@ class Simulator:
                     state["have_genuine"], k_round, b,
                 )
                 new_global = aggregate(
-                    state["global_params"], stacked, sizes, wmask, k_agg
+                    state["global_params"], stacked, sizes,
+                    wmask * (sizes > 0), k_agg
                 )
                 ok = train_ok
                 metrics = {"train_loss": loss}

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import optax
 
 from attackfl_tpu.config import Config
-from attackfl_tpu.data.partition import sample_round_indices
+from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indices
 from attackfl_tpu.ops import attacks
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.training.local import build_local_update, resolve_compute_dtype
@@ -90,13 +90,24 @@ def build_hyper_round(
             jnp.arange(num_clients)
         )
 
+    drop_rate = cfg.client_dropout_rate
+
     def round_step(hnet_params, prev_genuine, have_genuine, active_mask, rng, broadcast_number):
         broadcast_params, _emb = generate_all(hnet_params)
         broadcast_params = constrain(broadcast_params)
-        k_data, k_train, k_attack = jax.random.split(rng, 3)
+        if drop_rate > 0:
+            k_data, k_train, k_attack, k_drop = jax.random.split(rng, 4)
+        else:
+            k_data, k_train, k_attack = jax.random.split(rng, 3)
         idx, mask, sizes = sample_round_indices(
             k_data, num_clients, pool, lo, hi, client_pools
         )
+        if drop_rate > 0:
+            # straggler injection — the caller additionally skips dropped
+            # clients' hnet steps (engine passes active_mask * (sizes > 0))
+            sizes, mask, kept = apply_client_dropout(k_drop, sizes, mask, drop_rate)
+        else:
+            kept = jnp.ones((num_clients,), bool)
         idx, mask = constrain(idx), constrain(mask)
         train_keys = constrain(jax.random.split(k_train, num_clients))
         stacked, ok, losses = jax.vmap(local_update, in_axes=(0, 0, 0, 0))(
@@ -129,6 +140,8 @@ def build_hyper_round(
                 & any_active_genuine
             )
             grp_arr = jnp.asarray(grp.indices)
+            # a dropped attacker never reports (training/round.py)
+            active_rows = active & kept[grp_arr]
             own_params = pt.tree_take(broadcast_params, grp_arr)
 
             def attack_one(key, own):
@@ -143,15 +156,28 @@ def build_hyper_round(
             attacked = jax.vmap(attack_one)(keys, own_params)
 
             def scatter(s, a):
-                new_rows = jnp.where(active, a, s[grp_arr])
-                return s.at[grp_arr].set(new_rows)
+                sel = active_rows.reshape((-1,) + (1,) * (a.ndim - 1))
+                return s.at[grp_arr].set(jnp.where(sel, a, s[grp_arr]))
 
             stacked = jax.tree.map(scatter, stacked, attacked)
-            ok = ok.at[grp_arr].set(jnp.where(active, True, ok[grp_arr]))
+            ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
 
-        new_genuine = pt.tree_take(stacked, genuine_arr)
+        fresh = pt.tree_take(stacked, genuine_arr)
+        if drop_rate > 0:
+            # dropped genuine clients keep their last REPORTED update in
+            # the leak pool (see training/round.py round_step)
+            sel = kept[genuine_arr] | ~have_genuine
+            new_genuine = jax.tree.map(
+                lambda n, p: jnp.where(
+                    sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
+                fresh, prev_genuine,
+            )
+        else:
+            new_genuine = fresh
         ok = jnp.all(ok | ~active_mask.astype(bool))
-        loss = jnp.sum(losses * active_mask) / jnp.maximum(jnp.sum(active_mask), 1.0)
+        participating = active_mask * kept.astype(active_mask.dtype)
+        ok = ok & (jnp.sum(participating) > 0)
+        loss = jnp.sum(losses * participating) / jnp.maximum(jnp.sum(participating), 1.0)
         return stacked, sizes, new_genuine, ok, loss
 
     return round_step, generate_all

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from attackfl_tpu.config import Config
-from attackfl_tpu.data.partition import sample_round_indices
+from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indices
 from attackfl_tpu.ops import aggregators, attacks
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.training.local import (
@@ -152,11 +152,20 @@ def build_round_step(
         batched_update = jax.vmap(local_update, in_axes=(None, 0, 0, 0))
     constrain = constrain or (lambda tree: tree)
 
+    drop_rate = cfg.client_dropout_rate
+
     def round_step(global_params, prev_genuine, have_genuine, rng, broadcast_number):
-        k_data, k_train, k_attack = jax.random.split(rng, 3)
+        if drop_rate > 0:
+            k_data, k_train, k_attack, k_drop = jax.random.split(rng, 4)
+        else:
+            k_data, k_train, k_attack = jax.random.split(rng, 3)
         idx, mask, sizes = sample_round_indices(
             k_data, num_clients, pool, lo, hi, client_pools
         )
+        if drop_rate > 0:
+            sizes, mask, kept = apply_client_dropout(k_drop, sizes, mask, drop_rate)
+        else:
+            kept = jnp.ones((num_clients,), bool)
         idx, mask = constrain(idx), constrain(mask)
         train_keys = constrain(jax.random.split(k_train, num_clients))
         stacked, ok, losses = batched_update(global_params, train_keys, idx, mask)
@@ -166,6 +175,9 @@ def build_round_step(
             n_attackers = len(grp.indices)
             keys = jax.random.split(jax.random.fold_in(k_attack, gi), n_attackers)
             active = (broadcast_number >= grp.attack_round) & have_genuine
+            grp_arr = jnp.asarray(grp.indices)
+            # a dropped attacker never reports, so its row stays the no-op
+            active_rows = active & kept[grp_arr]
 
             def attack_one(key):
                 k_leak, k_noise = jax.random.split(key)
@@ -178,18 +190,36 @@ def build_round_step(
                 )
 
             attacked = jax.vmap(attack_one)(keys)
-            grp_arr = jnp.asarray(grp.indices)
 
             def scatter(s, a):
-                new_rows = jnp.where(active, a, s[grp_arr])
-                return s.at[grp_arr].set(new_rows)
+                sel = active_rows.reshape((-1,) + (1,) * (a.ndim - 1))
+                return s.at[grp_arr].set(jnp.where(sel, a, s[grp_arr]))
 
             stacked = jax.tree.map(scatter, stacked, attacked)
             # attackers that attacked did not train; their NaN status resets
-            ok = ok.at[grp_arr].set(jnp.where(active, True, ok[grp_arr]))
+            ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
 
-        new_genuine = pt.tree_take(stacked, genuine_arr)
-        return stacked, sizes, new_genuine, jnp.all(ok), jnp.mean(losses)
+        fresh = pt.tree_take(stacked, genuine_arr)
+        if drop_rate > 0:
+            # Dropped genuine clients never report, so their last REPORTED
+            # update stays in the leak pool (stale) — the reference
+            # accumulates only clients that sent an UPDATE
+            # (server.py:259-268).  Until a client has reported once
+            # (~have_genuine: the pool rows are still init placeholders)
+            # its fresh no-op row is used instead.
+            sel = kept[genuine_arr] | ~have_genuine
+            new_genuine = jax.tree.map(
+                lambda n, p: jnp.where(
+                    sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
+                fresh, prev_genuine,
+            )
+        else:
+            new_genuine = fresh
+        keptf = kept.astype(losses.dtype)
+        mean_loss = jnp.sum(losses * keptf) / jnp.maximum(jnp.sum(keptf), 1.0)
+        # a round where every client drops has no updates at all — fail it
+        # (the reference analog is a barrier deadlock, server.py:271-272)
+        return stacked, sizes, new_genuine, jnp.all(ok) & jnp.any(kept), mean_loss
 
     return round_step
 

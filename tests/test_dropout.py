@@ -1,0 +1,144 @@
+"""Straggler/dropout fault injection (cfg.client_dropout_rate).
+
+A dropped client reports nothing: its round size is 0, its stacked row is
+an exact no-op (unchanged broadcast params), size-weighted aggregators
+exclude it, and in hyper mode its hnet step is skipped.  The reference has
+no analog — its barrier waits forever on a silent client
+(/root/reference/server.py:271-272)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.training.engine import Simulator
+
+TINY = dict(num_data_range=(48, 64), epochs=1, batch_size=32,
+            train_size=256, test_size=128, log_path=".", checkpoint_dir=".")
+
+
+def _mixed_kept_round(sim, state, tries=20):
+    """Run round_step with rng candidates until some-but-not-all clients
+    drop; returns (stacked, sizes, global_params)."""
+    g = state["global_params"]
+    for i in range(tries):
+        rng = jax.random.key(1000 + i, impl=sim.cfg.prng_impl)
+        stacked, sizes, _gen, ok, _loss = sim.round_step(
+            g, state["prev_genuine"], jnp.asarray(False), rng, jnp.asarray(1)
+        )
+        sizes = np.asarray(sizes)
+        if 0 < (sizes == 0).sum() < sizes.size:
+            assert bool(ok)
+            return stacked, sizes, g
+    raise AssertionError(f"no mixed-dropout round in {tries} tries")
+
+
+def test_dropped_rows_are_exact_noops():
+    cfg = Config(num_round=1, total_clients=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.4, **TINY)
+    sim = Simulator(cfg)
+    state = sim.init_state()
+    stacked, sizes, g = _mixed_kept_round(sim, state)
+    for c in range(8):
+        row = jax.tree.map(lambda x, c=c: np.asarray(x[c]), stacked)
+        flat_r = np.concatenate([v.ravel() for v in jax.tree.leaves(row)])
+        flat_g = np.concatenate([np.asarray(v).ravel()
+                                 for v in jax.tree.leaves(g)])
+        if sizes[c] == 0:  # no-op: bit-identical to the broadcast params
+            np.testing.assert_array_equal(flat_r, flat_g)
+        else:
+            assert np.abs(flat_r - flat_g).max() > 0
+
+
+def test_dropped_genuine_clients_keep_stale_leak_entry():
+    """A dropped genuine client never reports, so its LAST reported update
+    stays in the leak pool (the reference accumulates reporting clients
+    only, server.py:259-268) — its no-op row must NOT overwrite it."""
+    cfg = Config(num_round=1, total_clients=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.4, **TINY)
+    sim = Simulator(cfg)
+    state = sim.init_state()
+    sentinel = jax.tree.map(lambda x: jnp.full_like(x, 7.0),
+                            state["prev_genuine"])
+    g = state["global_params"]
+    for i in range(20):
+        rng = jax.random.key(2000 + i, impl=cfg.prng_impl)
+        _stacked, sizes, new_genuine, ok, _ = sim.round_step(
+            g, sentinel, jnp.asarray(True), rng, jnp.asarray(1)
+        )
+        sizes = np.asarray(sizes)
+        if 0 < (sizes == 0).sum() < sizes.size:
+            break
+    else:
+        raise AssertionError("no mixed-dropout round found")
+    for c in range(8):  # all clients are genuine in this config
+        leaf = np.asarray(jax.tree.leaves(new_genuine)[0][c])
+        if sizes[c] == 0:  # stale: the sentinel previous entry survives
+            np.testing.assert_array_equal(leaf, 7.0)
+        else:  # fresh: a really-trained row, not the sentinel
+            assert np.abs(leaf - 7.0).max() > 1e-3
+
+
+def test_dropout_e2e_with_attack():
+    cfg = Config(num_round=3, total_clients=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.25,
+                 attacks=(AttackSpec(mode="LIE", num_clients=2, attack_round=2),),
+                 **TINY)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    assert "roc_auc" in hist[-1]
+
+
+def test_dropout_hyper_mode():
+    cfg = Config(num_round=2, total_clients=4, mode="hyper",
+                 model="TransformerModel", data_name="ICU",
+                 client_dropout_rate=0.25, **TINY)
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+
+
+def test_all_dropped_round_fails():
+    """A round where every client drops has no updates: ok=False, global
+    unchanged (retry semantics, like any failed round)."""
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.999, **TINY)
+    sim = Simulator(cfg)
+    state = sim.init_state()
+    stacked, sizes, _gen, ok, _loss = sim.round_step(
+        state["global_params"], state["prev_genuine"], jnp.asarray(False),
+        jax.random.key(0, impl=cfg.prng_impl), jnp.asarray(1)
+    )
+    assert np.asarray(sizes).sum() == 0  # deterministic at rate .999, seed 0
+    assert not bool(ok)
+
+
+def test_dropout_fused_scan_matches_per_round():
+    """The fused scan path applies the same dropout stream (trajectory
+    metrics match run_round's)."""
+    cfg = Config(num_round=3, total_clients=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.3, **TINY)
+    _, hist_a = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    sim_b = Simulator(cfg)
+    state = sim_b.init_state()
+    _, metrics = sim_b.run_scan(state, 3)
+    np.testing.assert_allclose(
+        [h["roc_auc"] for h in hist_a], np.asarray(metrics["roc_auc"]),
+        atol=1e-5,
+    )
+
+
+def test_config_validation_and_yaml():
+    from attackfl_tpu.config import config_from_dict
+
+    with pytest.raises(ValueError, match="client_dropout_rate"):
+        Config(client_dropout_rate=1.0)
+    with pytest.raises(ValueError, match="client_dropout_rate"):
+        Config(client_dropout_rate=-0.1)
+    c = config_from_dict({"server": {"client-dropout-rate": 0.2}})
+    assert c.client_dropout_rate == 0.2
