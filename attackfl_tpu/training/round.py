@@ -25,7 +25,6 @@ Key fidelity points:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Sequence
 
 import jax
