@@ -114,6 +114,30 @@ def test_kernel_matches_autodiff(model, data, params):
     assert abs(float(loss[0]) - float(ref_loss0)) < 1e-4
 
 
+def test_kernel_noops_fully_masked_client(data, params):
+    """A zero-sample client (straggler injection, cfg.client_dropout_rate)
+    must be an exact no-op in the fused kernel too: msum is guarded and
+    masked grads are zero, so Adam leaves its params bit-identical."""
+    keys = jax.random.split(jax.random.PRNGKey(9), C)
+    idx = jnp.zeros((C, 32), jnp.int32)
+    mask = jnp.ones((C, 32), bool).at[0].set(False)  # client 0 dropped
+    upd = fs.build_fused_local_update(
+        data, epochs=1, batch_size=B, lr=0.004, clip_grad_norm=1.0,
+        dropout=(0, 0, 0), g_clients=8, interpret=True,
+    )
+    new_p, ok, _loss = upd(params, keys, idx, mask)
+    assert bool(np.asarray(ok).all())
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(jax.tree.map(lambda x: x[0], new_p)),
+        jax.tree_util.tree_leaves_with_path(params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(path))
+    trained = jax.tree.leaves(jax.tree.map(lambda x: x[1], new_p))
+    assert any(np.abs(np.asarray(t) - np.asarray(p)).max() > 0
+               for t, p in zip(trained, jax.tree.leaves(params)))
+
+
 @pytest.mark.slow
 def test_pallas_backend_round(data):
     """End-to-end: a Simulator round with local_backend='pallas' (interpret
