@@ -57,26 +57,57 @@ def spectral_normalize(kernel: jnp.ndarray, n_iter: int = 15) -> jnp.ndarray:
     return kernel / (sigma + 1e-12)
 
 
+def _torch_linear_init(fan_in: int):
+    """torch.nn.Linear's default init: U(-1/√fan_in, 1/√fan_in) for kernel
+    AND bias."""
+    lim = 1.0 / math.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+
+    return init
+
+
 class SNDense(nn.Module):
     """Dense layer whose kernel is spectrally normalized at application
     time (the rebuild's ``nn.utils.spectral_norm(nn.Linear(...))``,
-    reference src/Model.py:258-262,328-332)."""
+    reference src/Model.py:258-262,328-332).  Params init like the torch
+    Linear being wrapped (see TorchDense)."""
 
     features: int
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        kernel = self.param(
-            "kernel",
-            nn.initializers.lecun_normal(),
-            (x.shape[-1], self.features),
-        )
-        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,))
+        init = _torch_linear_init(x.shape[-1])
+        kernel = self.param("kernel", init, (x.shape[-1], self.features))
+        bias = self.param("bias", init, (self.features,))
         return x @ spectral_normalize(kernel) + bias
 
 
+class TorchDense(nn.Module):
+    """Dense with ``torch.nn.Linear``'s default init — U(-1/√fan_in,
+    1/√fan_in) for kernel AND bias.  The hypernetwork's init distribution
+    IS the distribution of every client's initial model weights (the heads'
+    outputs), so the hypernetwork uses the torch reference's init rather
+    than flax's lecun-normal/zero-bias; final-metric parity is asserted in
+    tests/test_torch_parity.py against torch_parity.run_hyper."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        init = _torch_linear_init(x.shape[-1])
+        kernel = self.param("kernel", init, (x.shape[-1], self.features))
+        bias = self.param("bias", init, (self.features,))
+        return x @ kernel + bias
+
+
 def _dense(spec_norm: bool, features: int, name: str):
-    return (SNDense if spec_norm else nn.Dense)(features, name=name)
+    return (SNDense if spec_norm else TorchDense)(features, name=name)
+
+
+# torch nn.Embedding default: N(0, 1) per element
+_torch_embed_init = nn.initializers.normal(stddev=1.0)
 
 
 def _trunk(m, idx: jnp.ndarray) -> jnp.ndarray:
@@ -84,7 +115,8 @@ def _trunk(m, idx: jnp.ndarray) -> jnp.ndarray:
     client index -> (embedding, features).  ``m`` is a HyperNetwork or
     CNNHyper instance inside @nn.compact — identical parameter naming in
     both keeps their checkpoints head-for-head comparable."""
-    emd = nn.Embed(m.n_nodes, m.embedding_dim, name="embeddings")(idx)
+    emd = nn.Embed(m.n_nodes, m.embedding_dim, name="embeddings",
+                   embedding_init=_torch_embed_init)(idx)
     f = _dense(m.spec_norm, m.hidden_dim, "mlp_in")(emd)
     for i in range(m.n_hidden):
         f = _dense(m.spec_norm, m.hidden_dim, f"mlp_hidden{i}")(nn.relu(f))

@@ -16,7 +16,15 @@ backward (the reference clips stale grads, client.py:104-106), and the
 LIE attack deep-copies instead of mutating the leaked models in place
 (Utils.py:209-212).
 
-Usage:  python torch_parity.py --config 1|3|4 [--clients N] [--rounds R]
+Config 2 transcribes the hyper server mode (pFedHN): TorchHyperNetwork +
+the sequential ``autograd.grad(outputs=weights, grad_outputs=delta_theta)``
+update (/root/reference/server.py:637-680) and pooled per-client
+validation (/root/reference/src/Validation.py:178-214).  It runs on
+CNNModel (the hyper *machinery* is target-model-agnostic; the RNN of
+BASELINE config 2 has its own architecture-parity tests in
+tests/test_models.py).
+
+Usage:  python torch_parity.py --config 1|2|3|4 [--clients N] [--rounds R]
 Prints one JSON line: {"config":…, "final_roc_auc":…, "rounds_per_sec":…}.
 """
 
@@ -270,9 +278,113 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
     }
 
 
+class TorchHyperNetwork(nn.Module):
+    """Reference generic HyperNetwork (src/Model.py:251-304): Embedding ->
+    MLP (Linear + n_hidden x [ReLU, Linear]) -> one Linear head per target
+    state_dict entry, names sanitized "." -> "__" (src/Model.py:277)."""
+
+    def __init__(self, target_sd, n_nodes, embedding_dim=8, hidden_dim=100,
+                 n_hidden=2):
+        super().__init__()
+        self.embeddings = nn.Embedding(n_nodes, embedding_dim)
+        layers = [nn.Linear(embedding_dim, hidden_dim)]
+        for _ in range(n_hidden):
+            layers += [nn.ReLU(), nn.Linear(hidden_dim, hidden_dim)]
+        self.mlp = nn.Sequential(*layers)
+        self.shapes = {k: v.shape for k, v in target_sd.items()}
+        self.heads = nn.ModuleDict({
+            k.replace(".", "__"): nn.Linear(hidden_dim, v.numel())
+            for k, v in target_sd.items()
+        })
+
+    def forward(self, idx):
+        emd = self.embeddings(idx)
+        f = self.mlp(emd)
+        sd = {}
+        for safe, head in self.heads.items():
+            k = safe.replace("__", ".")
+            sd[k] = head(f).view(self.shapes[k])
+        return sd, emd
+
+
+def run_hyper(*, clients: int, rounds: int, epochs: int = 5,
+              batch_size: int = 128, lr: float = 0.004,
+              hyper_lr: float = 0.001, clip: float = 1.0,
+              num_data_range=(12000, 15000), train_size: int = 20000,
+              test_size: int = 4000, seed: int = 1) -> dict:
+    """The reference's hyper server mode (pFedHN) in torch: per round every
+    client trains from its hnet-generated weights, then the server walks
+    clients sequentially doing ``autograd.grad(outputs=weights,
+    grad_outputs=delta_theta)`` + one shared-Adam step (server.py:637-680),
+    and validation pools every client's personalized outputs into one
+    ROC-AUC (test_hyper_icu, src/Validation.py:178-214)."""
+    torch.manual_seed(seed)
+    random.seed(seed)
+    rng = np.random.default_rng(seed)
+
+    train = make_dataset("ICU", train_size, seed=seed)
+    test = make_dataset("ICU", test_size, seed=seed + 10_000)
+    target = TorchCNN()
+    hnet = TorchHyperNetwork(target.state_dict(), clients)
+    opt = torch.optim.Adam(hnet.parameters(), lr=hyper_lr)
+    lo, hi = num_data_range
+
+    auc = float("nan")
+    t0 = time.perf_counter()
+    for _rnd in range(1, rounds + 1):
+        updates = {}
+        for cid in range(clients):
+            with torch.no_grad():
+                weights, _ = hnet(torch.tensor([cid]))
+                weights = {k: v.clone() for k, v in weights.items()}
+            num_data = rng.integers(lo, hi + 1)
+            idx = rng.choice(train_size, size=min(num_data, train_size),
+                             replace=False)
+            upd = train_local(target, weights, train, idx, epochs=epochs,
+                              batch_size=batch_size, lr=lr, clip=clip)
+            if upd is not None:
+                updates[cid] = upd
+        # sequential hnet training through the shared Adam (server.py:644-670)
+        for cid, upd in updates.items():
+            weights, _ = hnet(torch.tensor([cid]))
+            delta = [weights[k].detach() - upd[k] for k in weights]
+            grads = torch.autograd.grad(
+                outputs=list(weights.values()), inputs=list(hnet.parameters()),
+                grad_outputs=delta,
+            )
+            opt.zero_grad()
+            for p, g in zip(hnet.parameters(), grads):
+                p.grad = g
+            if clip:
+                torch.nn.utils.clip_grad_norm_(hnet.parameters(), clip)
+            opt.step()
+
+        # pooled per-client validation
+        all_probs, all_labels = [], []
+        with torch.no_grad():
+            for cid in range(clients):
+                weights, _ = hnet(torch.tensor([cid]))
+                target.load_state_dict(weights)
+                target.eval()
+                probs = target(torch.from_numpy(test["vitals"]),
+                               torch.from_numpy(test["labs"]))[:, 0].numpy()
+                all_probs.append(probs)
+                all_labels.append(test["label"])
+        auc = roc_auc(np.concatenate(all_labels), np.concatenate(all_probs))
+    elapsed = time.perf_counter() - t0
+    return {
+        "config": 2,
+        "clients": clients,
+        "rounds": rounds,
+        "final_roc_auc": auc,
+        "rounds_per_sec": rounds / elapsed,
+        "seconds": elapsed,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", type=int, default=1, choices=(1, 3, 4))
+    ap.add_argument("--config", type=int, default=1, choices=(1, 2, 3, 4))
     ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--epochs", type=int, default=5)
@@ -280,14 +392,19 @@ def main():
     ap.add_argument("--test-size", type=int, default=4000)
     ap.add_argument("--num-data", type=int, nargs=2, default=None)
     args = ap.parse_args()
-    clients = args.clients if args.clients is not None else (3 if args.config == 1 else 100)
+    clients = args.clients if args.clients is not None else (3 if args.config in (1, 2) else 100)
     attackers = max(clients // 4, 1) if args.config == 4 else 0
     ndr = tuple(args.num_data) if args.num_data else (12000, 15000)
-    out = run(args.config, clients=clients, rounds=args.rounds,
-              epochs=args.epochs, train_size=args.train_size,
-              test_size=args.test_size, num_data_range=ndr,
-              attackers=attackers,
-              partition="dirichlet" if args.config == 3 else "iid")
+    if args.config == 2:
+        out = run_hyper(clients=clients, rounds=args.rounds,
+                        epochs=args.epochs, train_size=args.train_size,
+                        test_size=args.test_size, num_data_range=ndr)
+    else:
+        out = run(args.config, clients=clients, rounds=args.rounds,
+                  epochs=args.epochs, train_size=args.train_size,
+                  test_size=args.test_size, num_data_range=ndr,
+                  attackers=attackers,
+                  partition="dirichlet" if args.config == 3 else "iid")
     print(json.dumps(out))
 
 
