@@ -68,42 +68,34 @@ def _torch_linear_init(fan_in: int):
     return init
 
 
-class SNDense(nn.Module):
-    """Dense layer whose kernel is spectrally normalized at application
-    time (the rebuild's ``nn.utils.spectral_norm(nn.Linear(...))``,
-    reference src/Model.py:258-262,328-332).  Params init like the torch
-    Linear being wrapped (see TorchDense)."""
-
-    features: int
-
-    @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        init = _torch_linear_init(x.shape[-1])
-        kernel = self.param("kernel", init, (x.shape[-1], self.features))
-        bias = self.param("bias", init, (self.features,))
-        return x @ spectral_normalize(kernel) + bias
-
-
-class TorchDense(nn.Module):
+class HyperDense(nn.Module):
     """Dense with ``torch.nn.Linear``'s default init — U(-1/√fan_in,
-    1/√fan_in) for kernel AND bias.  The hypernetwork's init distribution
-    IS the distribution of every client's initial model weights (the heads'
-    outputs), so the hypernetwork uses the torch reference's init rather
-    than flax's lecun-normal/zero-bias; final-metric parity is asserted in
-    tests/test_torch_parity.py against torch_parity.run_hyper."""
+    1/√fan_in) for kernel AND bias — and optional application-time
+    spectral normalization of the kernel (the rebuild's
+    ``nn.utils.spectral_norm(nn.Linear(...))``, reference
+    src/Model.py:258-262,328-332).
+
+    The hypernetwork's init distribution IS the distribution of every
+    client's initial model weights (the heads' outputs), so it uses the
+    torch reference's init rather than flax's lecun-normal/zero-bias;
+    final-metric parity is asserted in tests/test_torch_parity.py against
+    torch_parity.run_hyper."""
 
     features: int
+    spec_norm: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         init = _torch_linear_init(x.shape[-1])
         kernel = self.param("kernel", init, (x.shape[-1], self.features))
         bias = self.param("bias", init, (self.features,))
+        if self.spec_norm:
+            kernel = spectral_normalize(kernel)
         return x @ kernel + bias
 
 
 def _dense(spec_norm: bool, features: int, name: str):
-    return (SNDense if spec_norm else TorchDense)(features, name=name)
+    return HyperDense(features, spec_norm=spec_norm, name=name)
 
 
 # torch nn.Embedding default: N(0, 1) per element

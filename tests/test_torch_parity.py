@@ -87,6 +87,17 @@ def test_parity_config2_hyper():
     assert abs(jax_auc - torch_out["final_roc_auc"]) < 0.12
 
 
+# HAR-family parity is measured, not CI-asserted: at the reduced scale a
+# CI box can afford (3 clients, 128-192 samples/round, 561-token
+# transformer on CPU), per-round accuracy is chaotic (swings 0.16-0.43
+# between adjacent rounds in both frameworks), so an endpoint assertion
+# is pure noise while costing ~19 min.  One-time measurement at 4 rounds
+# on the shared synthetic arrays: torch_parity.run_har 0.3125 final
+# accuracy vs JAX 0.3164 (chance = 1/6); the exact reproduce command for
+# the torch side is in run_har's docstring.  CI keeps the cheap HAR
+# invariants (tests/test_models.py, tests/test_e2e.py convergence).
+
+
 @pytest.mark.slow
 def test_parity_config3_noniid():
     """BASELINE config 3 (reduced): TransformerModel, 8 clients, Dirichlet

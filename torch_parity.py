@@ -24,7 +24,7 @@ CNNModel (the hyper *machinery* is target-model-agnostic; the RNN of
 BASELINE config 2 has its own architecture-parity tests in
 tests/test_models.py).
 
-Usage:  python torch_parity.py --config 1|2|3|4 [--clients N] [--rounds R]
+Usage:  python torch_parity.py --config 1|2|3|4|har [--clients N] [--rounds R]
 Prints one JSON line: {"config":…, "final_roc_auc":…, "rounds_per_sec":…}.
 """
 
@@ -278,6 +278,113 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
     }
 
 
+class TorchHARClassifier(nn.Module):
+    """Reference HAR TransformerClassifier (src/Model.py:435-458):
+    Conv1d(1->64, k3) + sinusoidal positional encoding + 2-layer
+    TransformerEncoder (nhead 4, ff 256) + mean-pool + MLP head, 6
+    classes.  batch_first layout here; same computation as the
+    reference's permute dance."""
+
+    def __init__(self, d_model: int = 64, num_classes: int = 6):
+        super().__init__()
+        self.conv = nn.Conv1d(1, d_model, 3, padding=1)
+        pos = np.arange(600, dtype=np.float64)[:, None]
+        div = np.exp(np.arange(0, d_model, 2, dtype=np.float64)
+                     * (-math.log(10000.0) / d_model))
+        pe = np.zeros((600, d_model), np.float32)
+        pe[:, 0::2] = np.sin(pos * div)
+        pe[:, 1::2] = np.cos(pos * div)
+        self.register_buffer("pe", torch.from_numpy(pe))
+        layer = nn.TransformerEncoderLayer(d_model, 4, 256, 0.1,
+                                           batch_first=True)
+        self.encoder = nn.TransformerEncoder(layer, 2)
+        self.head = nn.Sequential(nn.Linear(d_model, 64), nn.ReLU(),
+                                  nn.Dropout(0.3), nn.Linear(64, num_classes))
+
+    def forward(self, x):  # (B, 561)
+        h = self.conv(x[:, None, :]).permute(0, 2, 1)  # (B, 561, 64)
+        h = self.encoder(h + self.pe[None, : h.shape[1]])
+        return self.head(h.mean(dim=1))
+
+
+def train_har_local(model, state_dict, data, idx, *, epochs, batch_size, lr):
+    """One HAR client's local training (reference: client.train_HAR,
+    client.py:114-131 — CrossEntropy + Adam, NO grad clip, no NaN
+    tripwire)."""
+    model.load_state_dict(state_dict)
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    loss_fn = nn.CrossEntropyLoss()
+    x = torch.from_numpy(data["x"][idx])
+    y = torch.from_numpy(data["label"][idx]).long()
+    n = len(idx)
+    for _ in range(epochs):
+        perm = torch.randperm(n)
+        for s in range(0, n, batch_size):
+            b = perm[s:s + batch_size]
+            opt.zero_grad()
+            loss = loss_fn(model(x[b]), y[b])
+            loss.backward()
+            opt.step()
+    return {k: v.detach().clone() for k, v in model.state_dict().items()}
+
+
+def run_har(*, clients: int, rounds: int, epochs: int = 5,
+            batch_size: int = 128, lr: float = 0.004,
+            num_data_range=(12000, 15000), train_size: int = 20000,
+            test_size: int = 4000, seed: int = 1) -> dict:
+    """FedAvg on the HAR family: TransformerClassifier + accuracy metric
+    (reference: src/Validation.py:124-136).
+
+    Measured parity (2026-07-30, shared synthetic arrays, 3 clients, 4
+    rounds, 1 epoch, batch 32, 128-192 samples/round): torch 0.3125 final
+    accuracy vs JAX 0.3164 (chance = 1/6).  Not CI-asserted — per-round
+    accuracy at CI-affordable scale is chaotic in both frameworks (see
+    tests/test_torch_parity.py).  Reproduce the torch side with::
+
+        python torch_parity.py --config har --clients 3 --rounds 4 \\
+            --epochs 1 --batch-size 32 --train-size 512 --test-size 256 \\
+            --num-data 128 192
+    """
+    torch.manual_seed(seed)
+    random.seed(seed)
+    rng = np.random.default_rng(seed)
+
+    train = make_dataset("HAR", train_size, seed=seed)
+    test = make_dataset("HAR", test_size, seed=seed + 10_000)
+    model = TorchHARClassifier()
+    global_sd = {k: v.clone() for k, v in model.state_dict().items()}
+    lo, hi = num_data_range
+
+    acc = float("nan")
+    t0 = time.perf_counter()
+    for _rnd in range(1, rounds + 1):
+        updates, sizes = [], []
+        for _cid in range(clients):
+            num_data = rng.integers(lo, hi + 1)
+            idx = rng.choice(train_size, size=min(num_data, train_size),
+                             replace=False)
+            updates.append(train_har_local(
+                model, global_sd, train, idx, epochs=epochs,
+                batch_size=batch_size, lr=lr))
+            sizes.append(len(idx))
+        global_sd = fedavg(updates, sizes)
+        model.load_state_dict(global_sd)
+        model.eval()
+        with torch.no_grad():
+            logits = model(torch.from_numpy(test["x"]))
+        acc = float((logits.argmax(1).numpy() == test["label"]).mean())
+    elapsed = time.perf_counter() - t0
+    return {
+        "config": "HAR",
+        "clients": clients,
+        "rounds": rounds,
+        "final_accuracy": acc,
+        "rounds_per_sec": rounds / elapsed,
+        "seconds": elapsed,
+    }
+
+
 class TorchHyperNetwork(nn.Module):
     """Reference generic HyperNetwork (src/Model.py:251-304): Embedding ->
     MLP (Linear + n_hidden x [ReLU, Linear]) -> one Linear head per target
@@ -384,27 +491,31 @@ def run_hyper(*, clients: int, rounds: int, epochs: int = 5,
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--config", type=int, default=1, choices=(1, 2, 3, 4))
+    ap.add_argument("--config", type=str, default="1",
+                    choices=("1", "2", "3", "4", "har"))
     ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--train-size", type=int, default=20000)
     ap.add_argument("--test-size", type=int, default=4000)
     ap.add_argument("--num-data", type=int, nargs=2, default=None)
+    ap.add_argument("--batch-size", type=int, default=128)
     args = ap.parse_args()
-    clients = args.clients if args.clients is not None else (3 if args.config in (1, 2) else 100)
-    attackers = max(clients // 4, 1) if args.config == 4 else 0
+    clients = args.clients if args.clients is not None else (
+        3 if args.config in ("1", "2", "har") else 100)
+    attackers = max(clients // 4, 1) if args.config == "4" else 0
     ndr = tuple(args.num_data) if args.num_data else (12000, 15000)
-    if args.config == 2:
-        out = run_hyper(clients=clients, rounds=args.rounds,
-                        epochs=args.epochs, train_size=args.train_size,
-                        test_size=args.test_size, num_data_range=ndr)
+    common = dict(clients=clients, rounds=args.rounds, epochs=args.epochs,
+                  batch_size=args.batch_size, train_size=args.train_size,
+                  test_size=args.test_size, num_data_range=ndr)
+    if args.config == "2":
+        out = run_hyper(**common)
+    elif args.config == "har":
+        out = run_har(**common)
     else:
-        out = run(args.config, clients=clients, rounds=args.rounds,
-                  epochs=args.epochs, train_size=args.train_size,
-                  test_size=args.test_size, num_data_range=ndr,
-                  attackers=attackers,
-                  partition="dirichlet" if args.config == 3 else "iid")
+        out = run(int(args.config), attackers=attackers,
+                  partition="dirichlet" if args.config == "3" else "iid",
+                  **common)
     print(json.dumps(out))
 
 
