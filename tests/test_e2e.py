@@ -67,6 +67,32 @@ def test_checkpoint_resume(tmp_path):
     assert len([h for h in hist2 if h["ok"]]) == 2  # only the remainder ran
 
 
+def test_hyper_checkpoint_resume_and_class_mismatch(tmp_path):
+    """Hyper-mode resume round-trips (hnet + shared-Adam state + rng); a
+    checkpoint written under hyper_class=CNNHyper must fail with the
+    actionable structure-mismatch error when resumed as HyperNetwork."""
+    base = dict(BASE)
+    base.update(log_path=str(tmp_path), checkpoint_dir=str(tmp_path),
+                model="CNNModel")
+    cfg = Config(num_round=2, total_clients=3, mode="hyper",
+                 hyper_class="CNNHyper", **base)
+    sim = Simulator(cfg)
+    state, _ = sim.run(save_checkpoints=True, verbose=False)
+    assert int(state["completed_rounds"]) == 2
+
+    sim2 = Simulator(cfg.replace(load_parameters=True, num_round=3))
+    state2 = sim2.load_or_init_state()
+    assert int(state2["completed_rounds"]) == 2
+    state2, hist2 = sim2.run(state=state2, save_checkpoints=False, verbose=False)
+    assert int(state2["completed_rounds"]) == 3
+    assert len([h for h in hist2 if h["ok"]]) == 1  # only the remainder
+
+    bad = Simulator(cfg.replace(load_parameters=True,
+                                hyper_class="HyperNetwork"))
+    with pytest.raises(ValueError, match="does not match the current state"):
+        bad.load_or_init_state()
+
+
 def test_non_iid_partition_runs():
     cfg = Config(num_round=2, total_clients=4, mode="fedavg", partition="dirichlet",
                  dirichlet_alpha=0.3, **BASE)
