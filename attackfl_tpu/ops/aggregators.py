@@ -27,75 +27,141 @@ def fedavg(stacked: Any, sizes: jnp.ndarray) -> Any:
     return pt.tree_weighted_mean(stacked, sizes.astype(jnp.float32))
 
 
-def mean_aggregation(stacked: Any) -> Any:
-    """Unweighted mean (reference: avg_selected_parameters,
-    server.py:777-797, used after GMM filtering)."""
-    return pt.tree_mean(stacked)
+def mean_aggregation(stacked: Any, mask: jnp.ndarray | None = None) -> Any:
+    """Unweighted mean of (optionally mask-selected) clients (reference:
+    avg_selected_parameters, server.py:777-797, used after GMM filtering —
+    the engine's gmm mode calls this with the survivor mask)."""
+    if mask is None:
+        return pt.tree_mean(stacked)
+    return pt.tree_weighted_mean(stacked, mask)
 
 
-def median_aggregation(stacked: Any) -> Any:
+def _row_mask(mask: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a (C,) client mask over a (C, ...) stacked leaf."""
+    return mask.astype(bool).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+def median_aggregation(stacked: Any, mask: jnp.ndarray | None = None) -> Any:
     """Per-element median across clients (reference: median_aggregation,
     src/Utils.py:344-357).
 
     torch.median picks the lower of two middle values for even counts;
     we match that rather than jnp.median's midpoint interpolation.
-    """
 
-    def med(x):
-        n = x.shape[0]
-        sorted_x = jnp.sort(x, axis=0)
-        return sorted_x[(n - 1) // 2]
+    ``mask`` (C,), if given, excludes clients (dropped stragglers —
+    ADVICE r3 #2: a dropped client's row equals the unchanged broadcast
+    params and would otherwise vote "no change"): masked rows sort to
+    +inf and the lower-middle index is taken over the valid count only.
+    Static shapes throughout — the valid count is a traced scalar used
+    as a dynamic index, which XLA lowers to a dynamic-slice.
+    """
+    if mask is None:
+        def med(x):
+            n = x.shape[0]
+            sorted_x = jnp.sort(x, axis=0)
+            return sorted_x[(n - 1) // 2]
+    else:
+        v = jnp.sum(mask).astype(jnp.int32)
+
+        def med(x):
+            sorted_x = jnp.sort(jnp.where(_row_mask(mask, x), x, jnp.inf),
+                                axis=0)
+            return jnp.take(sorted_x, (v - 1) // 2, axis=0)
 
     return jax.tree.map(med, stacked)
 
 
-def trimmed_mean(stacked: Any, trim_ratio: float = 0.1) -> Any:
+def trimmed_mean(stacked: Any, trim_ratio: float = 0.1,
+                 mask: jnp.ndarray | None = None) -> Any:
     """Per-element sort, drop k = floor(n·ratio) at each end, mean the rest
-    (reference: trimmed_mean_aggregation, src/Utils.py:267-302)."""
-    n = jax.tree.leaves(stacked)[0].shape[0]
-    k = int(n * trim_ratio)
-    if 2 * k >= n:
-        raise ValueError("Too few clients for the chosen trim ratio.")
+    (reference: trimmed_mean_aggregation, src/Utils.py:267-302).
 
-    def trim(x):
-        sorted_x = jnp.sort(x, axis=0)
-        return jnp.mean(sorted_x[k : n - k], axis=0)
+    With ``mask`` the trim operates over valid rows only (masked rows
+    sort to +inf); k and the kept window become traced scalars selected
+    via an iota comparison so shapes stay static.  An over-trimmed valid
+    count (2k >= v) yields 0/0 = NaN, which the engine's NaN tripwire
+    turns into a failed round — the dynamic analog of the static
+    ValueError below."""
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    if mask is None:
+        k = int(n * trim_ratio)
+        if 2 * k >= n:
+            raise ValueError("Too few clients for the chosen trim ratio.")
+
+        def trim(x):
+            sorted_x = jnp.sort(x, axis=0)
+            return jnp.mean(sorted_x[k : n - k], axis=0)
+    else:
+        v = jnp.sum(mask).astype(jnp.int32)
+        kd = jnp.floor(v * trim_ratio).astype(jnp.int32)
+
+        def trim(x):
+            sorted_x = jnp.sort(jnp.where(_row_mask(mask, x), x, jnp.inf),
+                                axis=0)
+            i = jnp.arange(n).reshape((-1,) + (1,) * (x.ndim - 1))
+            w = ((i >= kd) & (i < v - kd)).astype(x.dtype)
+            finite = jnp.where(jnp.isfinite(sorted_x), sorted_x, 0.0)
+            return jnp.sum(finite * w, axis=0) / (v - 2 * kd).astype(x.dtype)
 
     return jax.tree.map(trim, stacked)
 
 
-def krum_select(stacked: Any, f: int = 0) -> jnp.ndarray:
+def krum_select(stacked: Any, f: int = 0,
+                mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """Krum score argmin (Blanchard et al. 2017).
 
     score_i = sum of the n−f−2 smallest squared L2 distances to the other
     clients; returns the index of the minimal-score client (reference:
     krum, src/Utils.py:326-342; f wiring server.py:384 — note the reference
-    effectively always uses f=0, SURVEY.md §2 row 15)."""
+    effectively always uses f=0, SURVEY.md §2 row 15).
+
+    With ``mask`` (C,), dropped clients are excluded on both sides:
+    distances to them become +inf (sorted last, selected out by an iota
+    window of length v−f−2 over the valid count v) and their own scores
+    become +inf so they are never chosen."""
     flat = pt.tree_ravel_stacked(stacked)  # (N, P)
     n = flat.shape[0]
     sq = jnp.sum(jnp.square(flat[:, None, :] - flat[None, :, :]), axis=-1)  # (N, N)
     # exclude self-distance (0 on the diagonal) the way the reference's
     # j != i loop does, then take the n-f-2 smallest of the rest
     sq = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, sq)
-    closest = jnp.sort(sq, axis=1)[:, : max(n - f - 2, 1)]
-    scores = jnp.sum(closest, axis=1)
-    return jnp.argmin(scores)
+    if mask is None:
+        closest = jnp.sort(sq, axis=1)[:, : max(n - f - 2, 1)]
+        scores = jnp.sum(closest, axis=1)
+        return jnp.argmin(scores)
+    valid = mask.astype(bool)
+    v = jnp.sum(mask).astype(jnp.int32)
+    m_neigh = jnp.maximum(v - f - 2, 1)
+    sorted_sq = jnp.sort(jnp.where(valid[None, :], sq, jnp.inf), axis=1)
+    w = (jnp.arange(n)[None, :] < m_neigh).astype(flat.dtype)
+    finite = jnp.where(jnp.isfinite(sorted_sq), sorted_sq, 0.0)
+    scores = jnp.sum(finite * w, axis=1)
+    return jnp.argmin(jnp.where(valid, scores, jnp.inf))
 
 
-def krum(stacked: Any, f: int = 0) -> Any:
+def krum(stacked: Any, f: int = 0, mask: jnp.ndarray | None = None) -> Any:
     """Return the selected client's full parameter tree."""
-    return pt.tree_take(stacked, krum_select(stacked, f))
+    return pt.tree_take(stacked, krum_select(stacked, f, mask))
 
 
-def shieldfl(stacked: Any, eps: float = 1e-6) -> Any:
+def shieldfl(stacked: Any, eps: float = 1e-6,
+             mask: jnp.ndarray | None = None) -> Any:
     """ShieldFL-style cosine-deviation weighting (reference inline code,
     server.py:306-350): normalize flat client vectors, reference = their
-    mean, weight_i ∝ 1/(1 − cos_i + ε), weighted average of raw params."""
+    mean, weight_i ∝ 1/(1 − cos_i + ε), weighted average of raw params.
+    With ``mask``, dropped clients are excluded from the reference
+    direction and zero-weighted in the average."""
     flat = pt.tree_ravel_stacked(stacked)
     unit = flat / (jnp.linalg.norm(flat, axis=1, keepdims=True) + 1e-8)
-    ref = jnp.mean(unit, axis=0)
+    if mask is None:
+        ref = jnp.mean(unit, axis=0)
+    else:
+        ref = jnp.sum(unit * mask[:, None], axis=0) / jnp.maximum(
+            jnp.sum(mask), 1.0)
     cos = (unit @ ref) / (jnp.linalg.norm(unit, axis=1) * jnp.linalg.norm(ref) + 1e-12)
     weights = 1.0 / (1.0 - cos + eps)
+    if mask is not None:
+        weights = weights * mask
     return pt.tree_weighted_mean(stacked, weights)
 
 

@@ -640,7 +640,12 @@ class Simulator:
             for i in range(n):
                 entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
                          for k, v in host.items()}
-                entry["seconds"] = elapsed / n
+                # A fused chunk is ONE device dispatch: per-round wall time
+                # is not observable inside it, so report the genuine chunk
+                # measurement instead of a synthetic per-round average
+                # (run()'s per-entry "seconds" IS genuine, engine.py:286).
+                entry["chunk_seconds"] = elapsed
+                entry["chunk_len"] = n
                 history.append(entry)
                 if entry["ok"]:
                     consecutive_failures = 0

@@ -238,25 +238,37 @@ def build_aggregator(
     uses sizes.
     """
     mode = cfg.mode
+    # Geometric modes ignore client weights by construction, but under
+    # straggler injection a dropped client's row equals the unchanged
+    # broadcast params — an implicit "no change" vote biasing robust
+    # aggregation toward the previous global (ADVICE r3 #2).  When
+    # dropout is configured, pass the participation mask so these modes
+    # operate over reporters only (real-straggler semantics); without
+    # dropout keep the exact static-shape paths.
+    geo_mask = cfg.client_dropout_rate > 0.0
 
     if mode == "fedavg" or mode == "fltracer":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
             return aggregators.fedavg(stacked, sizes.astype(jnp.float32) * weights_mask)
     elif mode == "gmm":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
-            return pt.tree_weighted_mean(stacked, weights_mask)
+            return aggregators.mean_aggregation(stacked, weights_mask)
     elif mode == "median":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
-            return aggregators.median_aggregation(stacked)
+            return aggregators.median_aggregation(
+                stacked, weights_mask if geo_mask else None)
     elif mode == "trimmed_mean":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
-            return aggregators.trimmed_mean(stacked, cfg.trim_ratio)
+            return aggregators.trimmed_mean(
+                stacked, cfg.trim_ratio, weights_mask if geo_mask else None)
     elif mode == "krum":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
-            return aggregators.krum(stacked, cfg.krum_f)
+            return aggregators.krum(
+                stacked, cfg.krum_f, weights_mask if geo_mask else None)
     elif mode == "shieldfl":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
-            return aggregators.shieldfl(stacked)
+            return aggregators.shieldfl(
+                stacked, mask=weights_mask if geo_mask else None)
     elif mode == "scionfl":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
             return aggregators.scionfl(stacked, sizes.astype(jnp.float32) * weights_mask, rng)

@@ -141,11 +141,17 @@ def north_star_config(log_path: str = "/tmp/attackfl_bench"):
 
 
 def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
-            trace_dir: str | None = None) -> dict:
+            trace_dir: str | None = None, progress: dict | None = None) -> dict:
     """Compile + run ``n_rounds`` via the fused scan (or run() for
     host-side modes), return rounds/s and the final quality metric.
     ``trace_dir`` captures a jax.profiler trace of the timed section
-    (inspect with tensorboard / xprof — SURVEY.md §5 tracing)."""
+    (inspect with tensorboard / xprof — SURVEY.md §5 tracing).
+    ``progress``, if given, is mutated in place as results land so a
+    deadline handler can emit best-so-far JSON (ADVICE r3 #1).  Failed
+    (NaN) rounds are *reported*, not asserted — at never-before-run
+    scales (the 1000-client north star) a NaN round is a realistic
+    first-run outcome and must not crash the measurement (VERDICT r3
+    weak #8)."""
     import contextlib
 
     import jax
@@ -153,7 +159,7 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
     from attackfl_tpu.training.engine import Simulator
 
     sim = Simulator(cfg)
-    out: dict = {}
+    out: dict = {} if progress is None else progress
     tracer = (jax.profiler.trace(trace_dir) if trace_dir
               else contextlib.nullcontext())
     if sim.supports_fused():
@@ -162,18 +168,22 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
         state, metrics = sim.run_scan(state, n_rounds)  # compile + run
         jax.block_until_ready(metrics)
         out["compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
-        assert all(map(bool, metrics["ok"])), f"warmup rounds failed: {metrics}"
+        warm_fail = sum(1 for ok in metrics["ok"] if not bool(ok))
+        if warm_fail:
+            out["warmup_failed_rounds"] = warm_fail
         t0 = time.perf_counter()
         with tracer:
             state, metrics = sim.run_scan(state, n_rounds)
             jax.block_until_ready(metrics)
         elapsed = time.perf_counter() - t0
-        assert all(map(bool, metrics["ok"])), f"timed rounds failed: {metrics}"
+        out["failed_rounds"] = sum(1 for ok in metrics["ok"]
+                                   if not bool(ok))
         final = {k: float(v[-1]) for k, v in metrics.items() if k != "ok"}
     else:  # host-side defense modes: per-round path
         state = sim.init_state()
         state, m = sim.run_round(state)  # warmup/compile
-        assert m["ok"], f"warmup round failed: {m}"
+        if not m["ok"]:
+            out["warmup_failed_rounds"] = 1
         t0 = time.perf_counter()
         hist = []
         with tracer:
@@ -181,9 +191,11 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
                 state, m = sim.run_round(state)
                 hist.append(m)
         elapsed = time.perf_counter() - t0
-        assert all(h["ok"] for h in hist), f"timed rounds failed: {hist[-1]}"
+        out["failed_rounds"] = sum(1 for h in hist if not h["ok"])
         final = {k: v for k, v in hist[-1].items()
                  if isinstance(v, float)}
+    if not out["failed_rounds"]:
+        del out["failed_rounds"]  # keep the common all-ok JSON compact
     out["rounds_per_sec"] = round(n_rounds / elapsed, 4)
     out["seconds_per_round"] = round(elapsed / n_rounds, 4)
     for k in metric_keys:
@@ -253,6 +265,10 @@ def main() -> None:
         best = [(k, v["rounds_per_sec"]) for k, v in
                 partial.get("backends_100c", {}).items()
                 if isinstance(v, dict) and "rounds_per_sec" in v]
+        # single-measurement modes write into `partial` directly
+        # (measure(..., progress=partial)) — pick up a completed rate there
+        if "rounds_per_sec" in partial:
+            best.append(("single", partial["rounds_per_sec"]))
         value = max((r for _, r in best), default=0.0)
         print(json.dumps({
             "metric": metric_name, "value": value, "unit": "rounds/s",
@@ -274,13 +290,17 @@ def main() -> None:
     on_tpu = jax.default_backend() == "tpu"
     cancel_watchdog()
 
-    def finish(res: dict, value_key: str = "rounds_per_sec") -> None:
+    def finish(res: dict, value_key: str = "rounds_per_sec",
+               vs_key: str = "vs_baseline") -> None:
+        # vs_key: --e2e-rounds divides an including-compile rate by the
+        # steady-state north-star constant; label it distinctly so table
+        # consumers don't compare incompatible denominators (ADVICE r3 #3)
         deadline_timer.cancel()
         print(json.dumps({
             "metric": metric_name,
             "value": res[value_key],
             "unit": "rounds/s",
-            "vs_baseline": round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4),
+            vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4),
             "detail": res,
         }))
 
@@ -290,7 +310,8 @@ def main() -> None:
             cfg = cfg.replace(local_backend=args.backend)
         if args.dtype:
             cfg = _with_dtype(cfg, args.dtype)
-        res = measure(cfg, 2, trace_dir=args.trace)
+        partial["config"] = "north star: 1000 clients, 200 LIE attackers"
+        res = measure(cfg, 2, trace_dir=args.trace, progress=partial)
         res["vs_north_star"] = round(
             res["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4)
         finish(res)
@@ -302,6 +323,8 @@ def main() -> None:
         cfg = make_config(4).replace(num_round=args.e2e_rounds)
         if args.dtype:
             cfg = _with_dtype(cfg, args.dtype)
+        partial["config"] = (f"headline config 4, {args.e2e_rounds} rounds "
+                             "end-to-end incl. compile")
         sim = Simulator(cfg)
         t0 = time.time()
         _, hist = sim.run_fast(save_checkpoints=False, verbose=False)
@@ -312,7 +335,8 @@ def main() -> None:
         auc = hist[-1].get("roc_auc")
         if auc is not None and auc == auc:  # NaN-guard: keep JSON strict
             res["roc_auc_final"] = round(auc, 4)
-        finish(res, value_key="rounds_per_sec_incl_compile")
+        finish(res, value_key="rounds_per_sec_incl_compile",
+               vs_key="vs_north_star_incl_compile")
         return
 
     if args.config is not None:  # single-row mode (BASELINE.md table filling)
@@ -323,7 +347,8 @@ def main() -> None:
             cfg = cfg.replace(local_backend=args.backend)
         if args.dtype:
             cfg = _with_dtype(cfg, args.dtype)
-        res = measure(cfg, args.rounds, trace_dir=args.trace)
+        partial["config"] = f"BASELINE config {args.config}"
+        res = measure(cfg, args.rounds, trace_dir=args.trace, progress=partial)
         finish(res)
         return
 

@@ -1,0 +1,36 @@
+#!/bin/bash
+# TPU availability watchdog: probe the axon tunnel on a schedule; the moment
+# a chip answers, run the full BASELINE measurement sweep (highest-priority
+# round-4 deliverable per VERDICT.md #1) and exit.  Probe log is committed as
+# evidence of attempts if the tunnel stays dead all round.
+#
+# Usage: bash scripts/tpu_watch.sh [interval_seconds]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-180}"
+LOG=tpu_probe.log
+PROBE='
+import time, json
+t0 = time.time()
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+y = (x @ x).block_until_ready()
+print(json.dumps({"ok": True, "backend": jax.default_backend(),
+                  "device": str(jax.devices()[0]), "init_s": round(time.time()-t0, 1)}))
+'
+echo "$(date -u +%FT%TZ) watchdog start interval=${INTERVAL}s" >> "$LOG"
+while true; do
+  OUT=$(timeout 300 python -c "$PROBE" 2>&1 | tail -1)
+  TS=$(date -u +%FT%TZ)
+  if echo "$OUT" | grep -q '"backend": "tpu"'; then
+    echo "$TS PROBE OK $OUT" >> "$LOG"
+    echo "$TS launching measure_baseline.py" >> "$LOG"
+    python scripts/measure_baseline.py --out baseline_rows.json \
+      >> baseline_sweep.log 2>&1
+    echo "$(date -u +%FT%TZ) sweep done rc=$?" >> "$LOG"
+    exit 0
+  else
+    echo "$TS probe failed: ${OUT:0:200}" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done

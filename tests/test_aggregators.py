@@ -124,3 +124,91 @@ def test_mean_aggregation():
     np.testing.assert_allclose(
         np.asarray(out["w"]), np.asarray(t["w"]).mean(0), rtol=1e-6
     )
+
+
+# ---------------------------------------------------------------------------
+# Masked (reporters-only) variants — straggler exclusion, ADVICE r3 #2.
+# Invariant: masked aggregation over C rows == unmasked aggregation over the
+# valid rows only, with static shapes (checked under jit).
+# ---------------------------------------------------------------------------
+
+def _subset(tree, idx):
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+@pytest.mark.parametrize("n,drop", [(7, (1, 4)), (8, (0, 3, 7))])
+def test_masked_median_equals_subset(n, drop):
+    t = stacked_tree(n, seed=3)
+    keep = np.array([i for i in range(n) if i not in drop])
+    mask = jnp.asarray(np.isin(np.arange(n), keep).astype(np.float32))
+    got = jax.jit(agg.median_aggregation)(t, mask)
+    want = agg.median_aggregation(_subset(t, keep))
+    for k in t:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("n,drop", [(10, (2, 5)), (9, (0, 8))])
+def test_masked_trimmed_mean_equals_subset(n, drop):
+    t = stacked_tree(n, seed=4)
+    keep = np.array([i for i in range(n) if i not in drop])
+    mask = jnp.asarray(np.isin(np.arange(n), keep).astype(np.float32))
+    got = jax.jit(lambda t, m: agg.trimmed_mean(t, 0.2, m))(t, mask)
+    want = agg.trimmed_mean(_subset(t, keep), 0.2)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-5)
+
+
+def test_masked_krum_never_selects_dropped():
+    """An attacker-like outlier row that is ALSO dropped must not be
+    selected, and the masked selection equals Krum over the valid subset."""
+    t = stacked_tree(6, seed=5)
+    t = {k: v.at[2].set(v[2] + 100.0) for k, v in t.items()}  # outlier
+    mask = jnp.asarray([1, 1, 0, 1, 1, 1], jnp.float32)  # drop the outlier
+    sel = int(jax.jit(agg.krum_select)(t, 0, mask))
+    assert sel != 2
+    keep = np.array([0, 1, 3, 4, 5])
+    want = int(agg.krum_select(_subset(t, keep), 0))
+    assert sel == keep[want]
+    got = jax.jit(agg.krum)(t, 0, mask)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(got[k]),
+                                   np.asarray(_subset(t, keep)[k][want]))
+
+
+def test_masked_shieldfl_equals_subset():
+    t = stacked_tree(6, seed=6)
+    mask = jnp.asarray([1, 0, 1, 1, 0, 1], jnp.float32)
+    got = jax.jit(lambda t, m: agg.shieldfl(t, mask=m))(t, mask)
+    keep = np.array([0, 2, 3, 5])
+    want = agg.shieldfl(_subset(t, keep))
+    for k in t:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-5)
+
+
+def test_masked_mean_aggregation_equals_subset():
+    t = stacked_tree(5, seed=7)
+    mask = jnp.asarray([1, 0, 1, 1, 0], jnp.float32)
+    got = jax.jit(agg.mean_aggregation)(t, mask)
+    want = agg.mean_aggregation(_subset(t, np.array([0, 2, 3])))
+    for k in t:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-6)
+
+
+def test_masked_all_ones_identical_to_static():
+    """All-ones mask reproduces the static paths bitwise (so wiring the
+    mask in under dropout cannot drift the no-dropout semantics)."""
+    t = stacked_tree(6, seed=8)
+    ones = jnp.ones((6,), jnp.float32)
+    for masked, static in (
+        (agg.median_aggregation(t, ones), agg.median_aggregation(t)),
+        (agg.trimmed_mean(t, 0.2, ones), agg.trimmed_mean(t, 0.2)),
+        (agg.krum(t, 0, ones), agg.krum(t, 0)),
+        (agg.shieldfl(t, mask=ones), agg.shieldfl(t)),
+    ):
+        for k in t:
+            np.testing.assert_allclose(np.asarray(masked[k]),
+                                       np.asarray(static[k]), rtol=1e-6)

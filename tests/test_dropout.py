@@ -142,3 +142,32 @@ def test_config_validation_and_yaml():
         Config(client_dropout_rate=-0.1)
     c = config_from_dict({"server": {"client-dropout-rate": 0.2}})
     assert c.client_dropout_rate == 0.2
+
+
+@pytest.mark.parametrize("mode", ["median", "trimmed_mean", "krum", "shieldfl"])
+def test_dropout_geometric_modes_reporters_only(mode):
+    """With dropout configured, geometric aggregators exclude dropped rows
+    (reporters-only; ADVICE r3 #2): the new global equals the unmasked
+    aggregator applied to just the reporting clients' rows."""
+    from attackfl_tpu.ops import aggregators as agg
+    from attackfl_tpu.training.round import build_aggregator
+
+    cfg = Config(num_round=2, total_clients=8, mode=mode,
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.4, **TINY)
+    sim = Simulator(cfg)
+    state = sim.init_state()
+    stacked, sizes, g = _mixed_kept_round(sim, state)
+    mask = jnp.asarray((sizes > 0).astype(np.float32))
+    aggregate = build_aggregator(sim.model, cfg, {k: jnp.asarray(v) for k, v in sim.test_np.items()})
+    got = aggregate(g, stacked, jnp.asarray(sizes.astype(np.float32)), mask,
+                    jax.random.key(0, impl=cfg.prng_impl))
+    keep = np.flatnonzero(sizes > 0)
+    sub = jax.tree.map(lambda x: x[keep], stacked)
+    want = {"median": lambda: agg.median_aggregation(sub),
+            "trimmed_mean": lambda: agg.trimmed_mean(sub, cfg.trim_ratio),
+            "krum": lambda: agg.krum(sub, cfg.krum_f),
+            "shieldfl": lambda: agg.shieldfl(sub)}[mode]()
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5,
+                                   atol=1e-6)

@@ -35,6 +35,7 @@ import copy
 import json
 import math
 import random
+import sys
 import time
 
 import numpy as np
@@ -267,6 +268,9 @@ def run(config_id: int, *, clients: int, rounds: int, epochs: int = 5,
             probs = model(torch.from_numpy(test["vitals"]),
                           torch.from_numpy(test["labs"]))[:, 0].numpy()
         auc = roc_auc(test["label"], probs)
+        print(json.dumps({"round": rnd, "roc_auc": round(float(auc), 4),
+                          "elapsed_s": round(time.perf_counter() - t0, 1)}),
+              file=sys.stderr, flush=True)
     elapsed = time.perf_counter() - t0
     return {
         "config": config_id,
