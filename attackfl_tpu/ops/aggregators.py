@@ -41,6 +41,15 @@ def _row_mask(mask: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return mask.astype(bool).reshape((-1,) + (1,) * (x.ndim - 1))
 
 
+def _valid_bad(mask: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-element flag: some VALID client contributed a non-finite value.
+    The masked aggregators sort masked rows to +inf and must neutralize
+    only those inserted sentinels — a diverged valid client's inf/NaN has
+    to poison the aggregate (→ NaN tripwire → failed round), exactly as it
+    would on the unmasked path."""
+    return jnp.any(~jnp.isfinite(x) & _row_mask(mask, x), axis=0)
+
+
 def median_aggregation(stacked: Any, mask: jnp.ndarray | None = None) -> Any:
     """Per-element median across clients (reference: median_aggregation,
     src/Utils.py:344-357).
@@ -66,7 +75,8 @@ def median_aggregation(stacked: Any, mask: jnp.ndarray | None = None) -> Any:
         def med(x):
             sorted_x = jnp.sort(jnp.where(_row_mask(mask, x), x, jnp.inf),
                                 axis=0)
-            return jnp.take(sorted_x, (v - 1) // 2, axis=0)
+            out = jnp.take(sorted_x, (v - 1) // 2, axis=0)
+            return jnp.where(_valid_bad(mask, x), jnp.nan, out)
 
     return jax.tree.map(med, stacked)
 
@@ -101,7 +111,8 @@ def trimmed_mean(stacked: Any, trim_ratio: float = 0.1,
             i = jnp.arange(n).reshape((-1,) + (1,) * (x.ndim - 1))
             w = ((i >= kd) & (i < v - kd)).astype(x.dtype)
             finite = jnp.where(jnp.isfinite(sorted_x), sorted_x, 0.0)
-            return jnp.sum(finite * w, axis=0) / (v - 2 * kd).astype(x.dtype)
+            out = jnp.sum(finite * w, axis=0) / (v - 2 * kd).astype(x.dtype)
+            return jnp.where(_valid_bad(mask, x), jnp.nan, out)
 
     return jax.tree.map(trim, stacked)
 
@@ -136,6 +147,18 @@ def krum_select(stacked: Any, f: int = 0,
     w = (jnp.arange(n)[None, :] < m_neigh).astype(flat.dtype)
     finite = jnp.where(jnp.isfinite(sorted_sq), sorted_sq, 0.0)
     scores = jnp.sum(finite * w, axis=1)
+    # the finite-zeroing above must only neutralize the inserted +inf
+    # sentinels; a candidate whose OWN params are non-finite (diverged)
+    # would otherwise look maximally close — poison its score so it is
+    # never selected.  Flag by own params, NOT by non-finite distances:
+    # distances are symmetric, so distance-based flagging would poison
+    # every client and degenerate argmin to index 0 (possibly a masked
+    # row).  Innocents' inf distances TO a diverged peer sort outside the
+    # m_neigh window (v-f-2 <= v-1-#diverged finite entries for f>=0 with
+    # one diverged client; with several, the zeroed tail only lowers all
+    # innocents' scores uniformly enough to keep selection sane).
+    bad = jnp.any(~jnp.isfinite(flat), axis=1)
+    scores = jnp.where(bad, jnp.inf, scores)
     return jnp.argmin(jnp.where(valid, scores, jnp.inf))
 
 

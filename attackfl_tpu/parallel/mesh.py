@@ -82,6 +82,44 @@ def replicate_to_mesh(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
+def gather_to_host(tree: Any) -> Any:
+    """Materialize a (possibly DCN-sharded) state tree as host-local numpy
+    on EVERY process — the gather half of multi-host checkpointing (the
+    reference torch.saves its full state_dict each round, server.py:549-553;
+    here the state is sharded over hosts, so saving needs one all-gather
+    over DCN first).  Typed PRNG keys come back as their raw uint32 key
+    data — exactly the checkpoint serialization format (save_state strips
+    keys anyway; load_state re-wraps from the template's impl).
+    """
+    from jax.experimental import multihost_utils
+
+    def g(x):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return g(jax.random.key_data(x))
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x) if hasattr(x, "shape") else x
+
+    return jax.tree.map(g, tree)
+
+
+def broadcast_bytes(data: bytes | None) -> bytes | None:
+    """Broadcast process 0's byte string to all processes (None if process
+    0 has none).  Lets every host deserialize the SAME checkpoint even when
+    the file only exists on process 0's filesystem — divergent host-local
+    restores would desync the SPMD round programs."""
+    from jax.experimental import multihost_utils
+
+    n = int(multihost_utils.broadcast_one_to_all(
+        np.asarray(len(data) if data is not None else -1, np.int64)))
+    if n < 0:
+        return None
+    local = (np.frombuffer(data, np.uint8)
+             if data is not None and len(data) == n
+             else np.zeros(n, np.uint8))
+    return multihost_utils.broadcast_one_to_all(local).tobytes()
+
+
 def make_client_mesh(num_devices: int = 0, axis_name: str = "clients") -> Mesh:
     """1-D mesh over ``num_devices`` (0 = all visible devices, including
     every remote process's devices after :func:`distributed_init`)."""

@@ -16,6 +16,7 @@ reference (which retries forever), retries are capped.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable
 
@@ -31,7 +32,8 @@ from attackfl_tpu.models.hyper import make_cnn_hyper, make_hypernetwork
 from attackfl_tpu.ops import defenses
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.parallel.mesh import (
-    is_multiprocess, make_client_mesh, make_constrain, replicate_to_mesh,
+    broadcast_bytes, gather_to_host, is_multiprocess, make_client_mesh,
+    make_constrain, replicate_to_mesh,
 )
 from attackfl_tpu.registry import get_model
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
@@ -113,8 +115,9 @@ class Simulator:
             self.mesh = None
         # Multi-host (DCN) mesh: every process runs this same Simulator
         # SPMD (parallel/mesh.distributed_init).  Host-side code must not
-        # materialize sharded arrays, and checkpoints are disabled (a
-        # host-local msgpack of a DCN-sharded tree would need a gather).
+        # materialize sharded arrays; checkpoints gather to process 0
+        # (_save_checkpoint) and resume via process-0 byte broadcast
+        # (load_or_init_state).
         self.multiprocess = is_multiprocess(self.mesh)
         if self.multiprocess and cfg.mode in ("gmm", "fltracer"):
             raise ValueError(
@@ -183,6 +186,17 @@ class Simulator:
     def init_state(self, seed: int | None = None) -> dict[str, Any]:
         """Fresh simulation state (the reference's fresh-init path,
         server.py:160-162)."""
+        state = self._init_host_state(seed)
+        if self.multiprocess:
+            # committed-to-local-device arrays can't feed a program over a
+            # multi-process mesh: replicate them globally (every process
+            # computed identical values from the shared seed)
+            state = replicate_to_mesh(state, self.mesh)
+        return state
+
+    def _init_host_state(self, seed: int | None = None) -> dict[str, Any]:
+        """Host-local fresh state (pre-replication) — also the structural
+        template multi-host resume deserializes checkpoint bytes against."""
         seed = self.cfg.random_seed if seed is None else seed
         # typed key: carries prng_impl (rbg by default — hardware RNG makes
         # dropout-mask generation ~4x cheaper on TPU than threefry)
@@ -220,26 +234,31 @@ class Simulator:
                 "completed_rounds": np.asarray(0),
                 "broadcasts": np.asarray(0),
             }
-        if self.multiprocess:
-            # committed-to-local-device arrays can't feed a program over a
-            # multi-process mesh: replicate them globally (every process
-            # computed identical values from the shared seed)
-            state = replicate_to_mesh(state, self.mesh)
         return state
 
     def load_or_init_state(self) -> dict[str, Any]:
         """Resume from checkpoint when configured
-        (reference: server.py:144-163,578-586)."""
+        (reference: server.py:144-163,578-586).
+
+        Multi-host: process 0's checkpoint bytes are broadcast so every
+        process restores IDENTICAL state (host-local files may differ or
+        be absent on workers), then re-replicated onto the DCN mesh."""
+        if self.cfg.load_parameters and self.multiprocess:
+            path = ckpt.checkpoint_path(self.cfg)
+            data = None
+            if jax.process_index() == 0 and os.path.exists(path):
+                with open(path, "rb") as fh:
+                    data = fh.read()
+            data = broadcast_bytes(data)
+            if data is None:
+                return self.init_state()
+            host = ckpt.load_state_bytes(data, self._init_host_state(), path)
+            print_with_color(
+                f"Load state from checkpoint (process-0 broadcast): {path}",
+                "yellow")
+            return replicate_to_mesh(host, self.mesh)
         state = self.init_state()
         if self.cfg.load_parameters:
-            if self.multiprocess:
-                # checkpoints are host-local files; resuming from them on N
-                # hosts with potentially different contents would desync
-                # the SPMD round programs (saving is likewise disabled)
-                print_with_color(
-                    "[mesh] multi-process run: ignoring parameters.load "
-                    "(checkpoints are host-local)", "yellow")
-                return state
             path = ckpt.checkpoint_path(self.cfg)
             try:
                 state = ckpt.load_state(path, state)
@@ -252,13 +271,18 @@ class Simulator:
     # one round
     # ------------------------------------------------------------------
 
-    def _checkpoints_allowed(self, requested: bool) -> bool:
-        """Single chokepoint for the multi-process checkpoint rule."""
-        if requested and self.multiprocess:
-            print_with_color("[mesh] multi-process run: checkpoints off "
-                             "(state is DCN-sharded)", "yellow")
-            return False
-        return requested
+    def _save_checkpoint(self, state: dict[str, Any]) -> None:
+        """Persist ``state`` (reference cadence: every successful round,
+        server.py:549-553).  Multi-host: gather the DCN-sharded tree to
+        host (one all-gather over DCN) and let process 0 alone write the
+        file — every process participates in the gather collective."""
+        path = ckpt.checkpoint_path(self.cfg)
+        if self.multiprocess:
+            host = gather_to_host(state)
+            if jax.process_index() == 0:
+                ckpt.save_state(path, host)
+        else:
+            ckpt.save_state(path, state)
 
     def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
         """Execute one broadcast->train->attack->aggregate->validate round.
@@ -516,11 +540,16 @@ class Simulator:
                     state["global_params"], state["prev_genuine"],
                     state["have_genuine"], k_round, b,
                 )
+                round_mask = wmask * (sizes > 0)
                 new_global = aggregate(
-                    state["global_params"], stacked, sizes,
-                    wmask * (sizes > 0), k_agg
+                    state["global_params"], stacked, sizes, round_mask, k_agg
                 )
-                ok = train_ok
+                # run_round's empty-reporters guard (engine.py run_round:
+                # "no clients reported"): with dropout an all-dropped round
+                # would feed an all-zero mask into the masked geometric
+                # aggregators (v=0 → inf/NaN global) — fail the round so
+                # `accept` keeps the previous params instead
+                ok = train_ok & jnp.any(round_mask > 0)
                 metrics = {"train_loss": loss}
                 if eval_fn is not None:
                     ev = eval_fn(params=new_global)
@@ -600,11 +629,17 @@ class Simulator:
         chunk_size: int | None = None,
         save_checkpoints: bool = True,
         verbose: bool = True,
+        progress: dict[str, Any] | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
         """Like :meth:`run` but on the fused scan path: one device dispatch
         per chunk instead of several per round.  Checkpoints land per chunk
         rather than per round (the reference checkpoints per round,
         server.py:549-553 — set ``chunk_size=1`` for that cadence).
+
+        ``progress``, if given, is updated in place after every chunk with
+        ``ok_rounds`` and ``interim_rounds_per_sec_incl_compile`` so a
+        watchdog (bench --deadline) can report best-so-far throughput if a
+        later dispatch wedges.
 
         Unlike :meth:`run`, the passed-in ``state``'s buffers are DONATED to
         the device program — do not reuse it after this call.
@@ -615,7 +650,7 @@ class Simulator:
         history: list[dict[str, Any]] = []
         consecutive_failures = 0  # run()'s retry counter semantics
         first_dispatch = True
-        save_checkpoints = self._checkpoints_allowed(save_checkpoints)
+        t_start = time.perf_counter()
 
         while int(state["completed_rounds"]) < num_rounds:
             remaining = num_rounds - int(state["completed_rounds"])
@@ -657,8 +692,13 @@ class Simulator:
                     "aborting (the reference would retry forever, "
                     "server.py:546-556)"
                 )
+            if progress is not None:
+                ok_so_far = sum(1 for h in history if h["ok"])
+                progress["ok_rounds"] = ok_so_far
+                progress["interim_rounds_per_sec_incl_compile"] = round(
+                    ok_so_far / (time.perf_counter() - t_start), 4)
             if save_checkpoints:
-                ckpt.save_state(ckpt.checkpoint_path(cfg), state)
+                self._save_checkpoint(state)
             if verbose:
                 done = int(state["completed_rounds"])
                 last = history[-1]
@@ -687,7 +727,6 @@ class Simulator:
         state = state if state is not None else self.load_or_init_state()
         history: list[dict[str, Any]] = []
         retries = 0
-        save_checkpoints = self._checkpoints_allowed(save_checkpoints)
         self.logger.log_info("### Application start ###")
 
         while int(state["completed_rounds"]) < num_rounds:
@@ -699,7 +738,7 @@ class Simulator:
             if metrics["ok"]:
                 retries = 0
                 if save_checkpoints:
-                    ckpt.save_state(ckpt.checkpoint_path(cfg), state)
+                    self._save_checkpoint(state)
                 if verbose:
                     keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in metrics]
                     msg = " ".join(f"{k}={metrics[k]:.4f}" for k in keys)

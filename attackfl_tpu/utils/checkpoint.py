@@ -41,6 +41,13 @@ def save_state(path: str, state: Any) -> None:
 def load_state(path: str, template: Any) -> Any:
     with open(path, "rb") as fh:
         data = fh.read()
+    return load_state_bytes(data, template, path)
+
+
+def load_state_bytes(data: bytes, template: Any, path: str = "<bytes>") -> Any:
+    """Deserialize checkpoint bytes against ``template`` (multi-host resume
+    broadcasts process 0's file bytes here so every process restores
+    identical state)."""
     try:
         loaded = serialization.from_bytes(_strip_keys(template), data)
     except ValueError as e:

@@ -36,27 +36,44 @@ def main() -> None:
     from attackfl_tpu.training.engine import Simulator
 
     mesh = make_client_mesh()
+    # CNNModel on purpose: this test exercises DCN plumbing (mesh span,
+    # collectives, checkpoint gather/broadcast), not model capacity — the
+    # Transformer's compile time would sink the fast tier it lives in.
     cfg = Config(
         num_round=1,
         total_clients=16,
         mode="fedavg",
-        model="TransformerModel",
+        model="CNNModel",
         data_name="ICU",
-        num_data_range=(48, 64),
+        num_data_range=(24, 32),
         epochs=1,
         batch_size=16,
-        train_size=256,
+        train_size=128,
         test_size=64,
         validation=True,
         genuine_rate=0.5,
         attacks=(AttackSpec(mode="LIE", num_clients=4, attack_round=1),),
         log_path=os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost"),
+        checkpoint_dir=os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost"),
     )
     sim = Simulator(cfg, mesh=mesh)
     assert sim.multiprocess, "mesh should span both processes"
-    state, history = sim.run(save_checkpoints=True, verbose=False)  # auto-disables
+    state, history = sim.run(save_checkpoints=True, verbose=False)
     ok_rounds = sum(1 for h in history if h["ok"])
     auc = history[-1].get("roc_auc", float("nan"))
+
+    # checkpointing over DCN: EVERY process resumes from process-0's
+    # broadcast bytes.  The resume is a collective — keep both processes in
+    # lockstep through it, and only assert afterwards (a pre-collective
+    # assert on one pid would leave the peer hanging in the broadcast).
+    from attackfl_tpu.utils import checkpoint as ckpt
+
+    resumed = Simulator(cfg.replace(load_parameters=True), mesh=mesh)
+    rstate = resumed.load_or_init_state()
+    resumed_rounds = int(jax.device_get(rstate["completed_rounds"]))
+    path = ckpt.checkpoint_path(cfg)  # MULTIHOST_TMP is shared in the test
+    assert os.path.exists(path), f"no checkpoint was written: {path}"
+    assert resumed_rounds == ok_rounds, (resumed_rounds, ok_rounds)
 
     # the fused lax.scan fast path must also run SPMD over the DCN mesh
     import numpy as np
@@ -65,7 +82,8 @@ def main() -> None:
     scan_ok = int(np.asarray(metrics["ok"]).sum())
     scan_auc = float(np.asarray(metrics["roc_auc"])[-1])
     print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f} "
-          f"scan_ok={scan_ok} scan_auc={scan_auc:.4f}", flush=True)
+          f"scan_ok={scan_ok} scan_auc={scan_auc:.4f} "
+          f"resumed_rounds={resumed_rounds}", flush=True)
 
 
 if __name__ == "__main__":

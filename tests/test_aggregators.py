@@ -212,3 +212,30 @@ def test_masked_all_ones_identical_to_static():
         for k in t:
             np.testing.assert_allclose(np.asarray(masked[k]),
                                        np.asarray(static[k]), rtol=1e-6)
+
+
+def test_masked_aggregators_propagate_valid_nonfinite():
+    """A diverged VALID client's inf/NaN must poison the masked aggregate
+    (NaN tripwire → failed round), exactly as on the unmasked path — only
+    the inserted +inf sentinels of masked rows are neutralized."""
+    t = stacked_tree(5, seed=9)
+    t = {k: v if k != "w" else v.at[1, 0, 0].set(jnp.inf) for k, v in t.items()}
+    mask = jnp.asarray([1, 1, 1, 1, 0], jnp.float32)  # client 1 IS valid
+    med = agg.median_aggregation(t, mask)
+    assert np.isnan(np.asarray(med["w"])[0, 0])
+    assert np.isfinite(np.asarray(med["w"])[1, 1])  # clean elements fine
+    tm = agg.trimmed_mean(t, 0.2, mask)
+    assert np.isnan(np.asarray(tm["w"])[0, 0])
+    assert np.isfinite(np.asarray(tm["w"])[1, 1])
+    # krum: the diverged client must never be selected despite its zeroed
+    # sentinel distances making it look "close"
+    assert int(agg.krum_select(t, 0, mask)) != 1
+    # and the poison must hit ONLY the diverged client — symmetric
+    # distance-based flagging would poison everyone and argmin would
+    # degenerate to index 0, here a MASKED row
+    t2 = stacked_tree(5, seed=10)
+    t2 = {k: v if k != "w" else v.at[2, 0, 0].set(jnp.inf)
+          for k, v in t2.items()}
+    mask2 = jnp.asarray([0, 1, 1, 1, 1], jnp.float32)
+    sel2 = int(agg.krum_select(t2, 0, mask2))
+    assert sel2 in (1, 3, 4), sel2  # valid, not masked(0), not diverged(2)

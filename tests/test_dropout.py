@@ -171,3 +171,21 @@ def test_dropout_geometric_modes_reporters_only(mode):
     for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5,
                                    atol=1e-6)
+
+
+def test_all_dropped_scan_round_fails_not_corrupts():
+    """Fused-scan guard: with validation OFF, an all-dropped round must be
+    flagged not-ok and leave the global params untouched — not feed an
+    all-zero mask into the masked geometric aggregators (v=0 → inf/NaN
+    global that every later round would train on)."""
+    cfg = Config(num_round=24, total_clients=4, mode="median",
+                 model="CNNModel", data_name="ICU",
+                 client_dropout_rate=0.8, validation=False, **TINY)
+    sim = Simulator(cfg)
+    state, metrics = sim.run_scan(sim.init_state(), 24)
+    ok = np.asarray(metrics["ok"])
+    # dropout 0.8 with 4 clients: P(all dropped) = 0.41/round;
+    # P(never in 24 rounds) ~ 3e-6
+    assert not ok.all(), "expected at least one all-dropped round"
+    for leaf in jax.tree.leaves(state["global_params"]):
+        assert np.isfinite(np.asarray(leaf)).all()

@@ -13,8 +13,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 DRIVER = os.path.join(os.path.dirname(__file__), "_multihost_driver.py")
 
 
@@ -24,8 +22,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
 def test_two_process_round(tmp_path):
+    """Fast-tier on purpose (VERDICT r3 weak #5): the DCN path is the most
+    fragile subsystem and must run in the tier developers actually use —
+    it is a 2-process, 1-round CPU test."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {**os.environ, "MULTIHOST_TMP": str(tmp_path)}
     env.pop("JAX_PLATFORMS", None)  # driver pins cpu itself
