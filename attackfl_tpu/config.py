@@ -117,12 +117,22 @@ class Config:
     # (reference: spec_norm ctor flag, src/Model.py:252,310; always False
     # where instantiated, server.py:800).
     hyper_spec_norm: bool = False
+    # How the hypernetwork consumes the round's client updates:
+    # "sequential" replicates the reference's per-client loop through one
+    # shared Adam state (server.py:644-670) — an O(C) serial chain of
+    # vjp+Adam steps, order-faithful but the predicted bottleneck at
+    # 100-1000 clients (SURVEY.md §7).  "batched" vmaps the per-client
+    # vjp grads, averages them over active clients, and takes ONE Adam
+    # step per round — a different (minibatch-style) trajectory with the
+    # same fixed-point structure, fully parallel on the MXU.
+    hyper_update_mode: str = "sequential"
     # Straggler/dropout fault injection (SURVEY.md §5): each round every
     # client independently fails to report with this probability.  A
     # dropped client contributes no update that round: size-weighted
     # aggregators exclude it exactly (its round size is 0), geometric
-    # aggregators (median/krum/trimmed-mean/shieldfl) see an unchanged
-    # replica, in hyper mode its hnet step is skipped, and its last
+    # aggregators (median/krum/trimmed-mean/shieldfl) operate over
+    # reporters only (masked variants), in hyper mode its hnet step is
+    # skipped, and its last
     # REPORTED update stays (stale) in the genuine-leak pool.  The
     # reference has no dropout handling at all — its round barrier waits
     # forever on a silent client (server.py:271-272); here a round where
@@ -226,6 +236,11 @@ class Config:
                 f"{self.client_dropout_rate} (1.0 would drop every client "
                 "every round; the reference analog is a barrier deadlock)"
             )
+        if self.hyper_update_mode not in ("sequential", "batched"):
+            raise ValueError(
+                f"Unknown hyper_update_mode {self.hyper_update_mode!r}; "
+                "choose 'sequential' (reference-faithful) or 'batched'"
+            )
         if self.hyper_class not in ("HyperNetwork", "CNNHyper"):
             raise ValueError(
                 f"Unknown hyper_class {self.hyper_class!r}; choose "
@@ -317,6 +332,8 @@ def config_from_dict(raw: dict) -> Config:
                                        defaults.client_dropout_rate)),
         hyper_class=str(_get(server, "hyper-class", defaults.hyper_class)),
         hyper_spec_norm=bool(_get(server, "hyper-spec-norm", defaults.hyper_spec_norm)),
+        hyper_update_mode=str(_get(server, "hyper-update-mode",
+                                   defaults.hyper_update_mode)),
         partition=str(_get(server, "partition", defaults.partition)),
         dirichlet_alpha=float(_get(server, "dirichlet-alpha", defaults.dirichlet_alpha)),
         epochs=int(_get(learning, "epoch", defaults.epochs)),

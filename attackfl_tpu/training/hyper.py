@@ -193,12 +193,45 @@ def build_hyper_update(
     ``hyper_update(hnet_params, opt_state, stacked_client_params,
     active_mask) -> (hnet_params, opt_state)``
 
-    Sequential scan over clients through the shared Adam state — the
-    faithful re-expression of the reference's per-client loop
-    (server.py:644-670).  Inactive clients are skipped by keeping the carry
-    unchanged (masked select).
+    Two variants, selected by ``cfg.hyper_update_mode``:
+
+    - ``sequential`` (default): scan over clients through the shared Adam
+      state — the faithful re-expression of the reference's per-client
+      loop (server.py:644-670).  Inactive clients are skipped by keeping
+      the carry unchanged (masked select).  O(C) serial vjp+Adam chain.
+    - ``batched``: vmap all per-client vjp grads, average over active
+      clients, ONE Adam step per round.  Fully parallel (the C vjps batch
+      onto the MXU and shard over the client mesh axis), but a different
+      trajectory: minibatch-style gradient averaging instead of C
+      sequential Adam steps — accuracy equivalence is asserted at
+      convergence level, not per-step (tests/test_hyper_batched.py).
+      Memory: materializes C hnet-grad trees; at very large C prefer
+      sharding over the client axis (the engine's mesh does this).
     """
     tx = make_hyper_optimizer(cfg)
+
+    if cfg.hyper_update_mode == "batched":
+        def hyper_update(hnet_params, opt_state, stacked_client_params, active_mask):
+            def client_grad(i, client_params):
+                weights, vjp_fn = jax.vjp(lambda p: hnet_apply(p, i)[0],
+                                          hnet_params)
+                delta_theta = jax.tree.map(lambda w, c: w - c, weights,
+                                           client_params)
+                (grads,) = vjp_fn(delta_theta)
+                return grads
+
+            grads = jax.vmap(client_grad)(jnp.arange(num_clients),
+                                          stacked_client_params)
+            mean_grads = pt.tree_weighted_mean(grads, active_mask)
+            updates, new_opt = tx.update(mean_grads, opt_state, hnet_params)
+            new_hp = optax.apply_updates(hnet_params, updates)
+            # all-inactive round (every client dropped/removed): no step
+            any_active = jnp.sum(active_mask) > 0
+            sel = lambda n, o: jnp.where(any_active, n, o)  # noqa: E731
+            return (jax.tree.map(sel, new_hp, hnet_params),
+                    jax.tree.map(sel, new_opt, opt_state))
+
+        return hyper_update, tx
 
     def hyper_update(hnet_params, opt_state, stacked_client_params, active_mask):
         def body(carry, xs):

@@ -220,6 +220,11 @@ def main() -> None:
     parser.add_argument("--dtype", choices=["float32", "bfloat16"], default=None,
                         help="compute dtype for the xla local-training "
                              "backend (mesh.compute-dtype)")
+    parser.add_argument("--hyper-update", choices=["sequential", "batched"],
+                        default=None,
+                        help="hyper-mode server update variant (config 2): "
+                             "reference-faithful O(C) sequential scan vs "
+                             "one batched Adam step per round")
     parser.add_argument("--clients", type=int, default=None)
     parser.add_argument("--rounds", type=int, default=4,
                         help="timed rounds per measurement")
@@ -243,11 +248,15 @@ def main() -> None:
         parser.error("--config / --north-star / --e2e-rounds are exclusive")
     single = (args.config is not None or args.north_star
               or args.e2e_rounds is not None)
-    if not single and (args.backend or args.clients or args.trace or args.dtype):
-        parser.error("--backend/--clients/--dtype/--trace apply to a single "
-                     "measurement; add --config N / --north-star / --e2e-rounds")
+    if not single and (args.backend or args.clients or args.trace or args.dtype
+                       or args.hyper_update):
+        parser.error("--backend/--clients/--dtype/--hyper-update/--trace "
+                     "apply to a single measurement; add --config N / "
+                     "--north-star / --e2e-rounds")
     if args.clients and args.config is None:
         parser.error("--clients applies to --config rows")
+    if args.hyper_update and args.config != 2:
+        parser.error("--hyper-update applies to --config 2 (hyper mode)")
     if args.e2e_rounds is not None and args.backend:
         parser.error("--e2e-rounds measures the xla run_fast path; --backend "
                      "does not apply")
@@ -367,6 +376,8 @@ def main() -> None:
             cfg = cfg.replace(local_backend=args.backend)
         if args.dtype:
             cfg = _with_dtype(cfg, args.dtype)
+        if args.hyper_update:
+            cfg = cfg.replace(hyper_update_mode=args.hyper_update)
         partial["config"] = f"BASELINE config {args.config}"
         res = measure(cfg, args.rounds, trace_dir=args.trace, progress=partial)
         finish(res)

@@ -95,6 +95,11 @@ def main() -> None:
         *[(f"config{n}", bench_row("--config", str(n))) for n in range(1, 6)],
         ("config4_bf16", bench_row("--config", "4", "--dtype", "bfloat16")),
         ("config4_pallas", bench_row("--config", "4", "--backend", "pallas")),
+        # hyper-mode sequential-vs-batched at 100 clients: the data for
+        # SURVEY §7's parity decision (VERDICT r3 #4)
+        ("hyper_100c_seq", bench_row("--config", "2", "--clients", "100")),
+        ("hyper_100c_batched", bench_row("--config", "2", "--clients", "100",
+                                         "--hyper-update", "batched")),
         ("north_star_1000c", bench_row("--north-star")),
         ("run_100_rounds_e2e", bench_row("--e2e-rounds", "100")),
     ]
