@@ -91,16 +91,21 @@ def main() -> None:
         skip |= {"config4_pallas", "north_star_1000c"}
         out["note"] = "off-TPU: pallas + north-star steps auto-skipped"
 
+    # Ordered by judged priority, not config number: if the tunnel only
+    # stays up for a short window, the headline row, the Pallas
+    # prove-or-demote row and the north star must land before the
+    # small-config rows (VERDICT r3 next-round #1-#3).
     steps: list[tuple[str, list[str]]] = [
-        *[(f"config{n}", bench_row("--config", str(n))) for n in range(1, 6)],
-        ("config4_bf16", bench_row("--config", "4", "--dtype", "bfloat16")),
+        ("config4", bench_row("--config", "4")),
         ("config4_pallas", bench_row("--config", "4", "--backend", "pallas")),
+        ("config4_bf16", bench_row("--config", "4", "--dtype", "bfloat16")),
+        ("north_star_1000c", bench_row("--north-star")),
+        *[(f"config{n}", bench_row("--config", str(n))) for n in (1, 2, 3, 5)],
         # hyper-mode sequential-vs-batched at 100 clients: the data for
         # SURVEY §7's parity decision (VERDICT r3 #4)
         ("hyper_100c_seq", bench_row("--config", "2", "--clients", "100")),
         ("hyper_100c_batched", bench_row("--config", "2", "--clients", "100",
                                          "--hyper-update", "batched")),
-        ("north_star_1000c", bench_row("--north-star")),
         ("run_100_rounds_e2e", bench_row("--e2e-rounds", "100")),
     ]
 
