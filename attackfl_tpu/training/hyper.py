@@ -30,7 +30,7 @@ from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indic
 from attackfl_tpu.ops import attacks
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.training.local import build_local_update, resolve_compute_dtype
-from attackfl_tpu.training.round import AttackGroup
+from attackfl_tpu.training.round import AttackGroup, map_attackers
 
 Batch = dict[str, jnp.ndarray]
 
@@ -153,7 +153,13 @@ def build_hyper_round(
                 leaked = pt.tree_take(prev_genuine, leak)
                 return attacks.apply_attack(grp.mode, own, leaked, k_noise, grp.args)
 
-            attacked = jax.vmap(attack_one)(keys, own_params)
+            # memory-bounded over attackers (see round.map_attackers: the
+            # per-attacker leak gather OOMs at north-star scale if vmapped
+            # all at once)
+            attacked = map_attackers(
+                lambda ko: attack_one(*ko), (keys, own_params),
+                n_attackers, min(leak_k, num_genuine),
+                jax.tree.map(lambda x: x[0], own_params))
 
             def scatter(s, a):
                 sel = active_rows.reshape((-1,) + (1,) * (a.ndim - 1))

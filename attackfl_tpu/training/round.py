@@ -41,6 +41,28 @@ from attackfl_tpu.training.local import (
 
 Batch = dict[str, jnp.ndarray]
 
+# Memory budget (elements, not bytes) for the per-attacker leak gather.
+# Each attacker materializes its own (leak_k, P) leaked-tree sample; a
+# plain vmap over attackers allocates (n_attackers, leak_k, P) AT ONCE —
+# 3.8e9 floats (15+ GB) at the 1000-client north star (200 attackers x
+# 400 leaked x 48k params), which would OOM a 16 GB TPU chip and was
+# OOM-killed at 130 GB RSS on CPU (XLA temporaries multiply it).
+ATTACK_GATHER_BUDGET = int(2e8)  # ~800 MB f32 peak per chunk
+
+
+def map_attackers(attack_one: Callable, xs: Any, n_attackers: int,
+                  leak_k: int, params_template: Any) -> Any:
+    """Evaluate the per-attacker closure over stacked inputs ``xs`` with
+    bounded peak memory: plain vmap while the full (n_attackers, leak_k, P)
+    gather fits ``ATTACK_GATHER_BUDGET``, otherwise ``lax.map`` with a
+    batch size that keeps each chunk's gather under it (sequential chunks
+    of vmapped attackers — identical results, bounded temporaries)."""
+    p_total = sum(x.size for x in jax.tree.leaves(params_template))
+    chunk = max(1, ATTACK_GATHER_BUDGET // max(leak_k * p_total, 1))
+    if chunk >= n_attackers:
+        return jax.vmap(attack_one)(xs)
+    return jax.lax.map(attack_one, xs, batch_size=chunk)
+
 
 @dataclass(frozen=True)
 class AttackGroup:
@@ -188,7 +210,8 @@ def build_round_step(
                     grp.mode, global_params, leaked, k_noise, grp.args
                 )
 
-            attacked = jax.vmap(attack_one)(keys)
+            attacked = map_attackers(attack_one, keys, n_attackers,
+                                     min(leak_k, num_genuine), global_params)
 
             def scatter(s, a):
                 sel = active_rows.reshape((-1,) + (1,) * (a.ndim - 1))

@@ -141,3 +141,72 @@ def test_attacks_jit_and_vmap():
     out = many(jax.random.split(jax.random.PRNGKey(0), 3))
     assert jax.tree.leaves(out)[0].shape[0] == 3
     assert np.all(np.isfinite(np.asarray(out["a"])))
+
+
+def test_map_attackers_chunked_equals_vmap(monkeypatch):
+    """Memory-bounded attacker evaluation (lax.map chunks) must produce
+    bitwise the same rows as the plain vmap it replaces — including a
+    remainder chunk (5 attackers, chunk 2)."""
+    import jax
+
+    from attackfl_tpu.training import round as round_mod
+
+    template = {"w": jnp.zeros((37,), jnp.float32)}
+    pool = {"w": jnp.asarray(np.random.default_rng(0)
+                             .normal(size=(8, 37)).astype(np.float32))}
+
+    def attack_one(key):
+        k_leak, k_noise = jax.random.split(key)
+        leak = jax.random.choice(k_leak, 8, (4,), replace=False)
+        leaked = {"w": pool["w"][leak]}
+        return {"w": leaked["w"].mean(0)
+                + 0.01 * jax.random.normal(k_noise, (37,))}
+
+    keys = jax.random.split(jax.random.key(7), 5)
+    want = jax.vmap(attack_one)(keys)
+    # budget 2*4*37 => chunk 2 over 5 attackers: a GENUINE remainder
+    # chunk, the path most likely to pad/misalign rows
+    monkeypatch.setattr(round_mod, "ATTACK_GATHER_BUDGET", 2 * 4 * 37)
+    got = round_mod.map_attackers(attack_one, keys, 5, 4, template)
+    # chunked lowering reassociates the mean reduction: one-ULP float
+    # drift is expected, rng draws and leak indices are bitwise identical
+    np.testing.assert_allclose(np.asarray(got["w"]), np.asarray(want["w"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_round_step_chunked_attackers_match(monkeypatch):
+    """A full round with LIE attackers under a tiny gather budget matches
+    the unchunked round (same seed; ULP-level reduction drift only)."""
+    import jax
+
+    from attackfl_tpu.config import AttackSpec, Config
+    from attackfl_tpu.training import round as round_mod
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = Config(num_round=2, total_clients=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU",
+                 num_data_range=(48, 64), epochs=1, batch_size=32,
+                 train_size=256, test_size=128, log_path=".",
+                 checkpoint_dir=".",
+                 attacks=(AttackSpec(mode="LIE", num_clients=3,
+                                     attack_round=1),))
+
+    def run_once():
+        sim = Simulator(cfg)
+        state = sim.init_state()
+        state["prev_genuine"] = jax.tree.map(
+            lambda x: jnp.stack([x] * len(sim.genuine_idx)),
+            state["global_params"])
+        state["have_genuine"] = np.asarray(True)
+        stacked, sizes, gen, ok, loss = sim.round_step(
+            state["global_params"], state["prev_genuine"],
+            jnp.asarray(True), jax.random.key(3, impl=cfg.prng_impl),
+            jnp.asarray(2))
+        return jax.tree.leaves(stacked)
+
+    want = run_once()
+    monkeypatch.setattr(round_mod, "ATTACK_GATHER_BUDGET", 1)
+    got = run_once()
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
