@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-4 measurement queue: runs the CPU evidence jobs SEQUENTIALLY once
+# the full-scale torch parity run frees the core (the 1-core box can't
+# overlap them — an 8-device virtual-mesh collective already died once to
+# rendezvous skew under contention).
+set -u
+cd "$(dirname "$0")/.."
+echo "queue start $(date -u +%FT%TZ)" >> evening_queue.log
+while pgrep -f "torch_parity.py --config 4" > /dev/null; do sleep 120; done
+echo "torch done $(date -u +%FT%TZ)" >> evening_queue.log
+nice -n 5 python -u scripts/northstar_cpu.py --rounds 3 > northstar_cpu.log 2>&1
+echo "northstar rc=$? $(date -u +%FT%TZ)" >> evening_queue.log
+nice -n 5 python -u scripts/full_parity_jax.py > full_parity_jax.log 2>&1
+echo "full_parity_jax rc=$? $(date -u +%FT%TZ)" >> evening_queue.log
+nice -n 5 python -u scripts/har_parity.py > har_parity.log 2>&1
+echo "har_parity rc=$? $(date -u +%FT%TZ)" >> evening_queue.log
+echo "QUEUE_DONE $(date -u +%FT%TZ)" >> evening_queue.log

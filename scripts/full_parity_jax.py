@@ -1,0 +1,76 @@
+"""The JAX side of the full-scale same-host parity run (VERDICT r3 #5).
+
+Mirrors ``torch_parity.run(4, clients=100, rounds=30)`` exactly: same
+synthetic arrays (make_dataset seed 1 / test seed 10001 — Config defaults),
+same reference hyperparameters (100 clients, 25 LIE attackers z=0.74 from
+round 2, 5 epochs, batch 128, lr 0.004, clip 1.0, 12-15k samples/client/
+round, genuine-rate 0.5), 30 rounds.  Prints one JSON line with final
+ROC-AUC and steady-state + incl-compile rounds/s; paste next to the torch
+line in BASELINE.md.
+
+Usage: python -u scripts/full_parity_jax.py [--rounds 30] [--out FULL_PARITY_JAX.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # same-host claim => same CPU
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--out", type=str,
+                    default=str(Path(__file__).resolve().parent.parent
+                                / "FULL_PARITY_JAX.json"))
+    args = ap.parse_args()
+
+    from attackfl_tpu.config import AttackSpec, Config
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = Config(
+        num_round=args.rounds, total_clients=100, mode="fedavg",
+        model="TransformerModel", data_name="ICU",
+        num_data_range=(12000, 15000), epochs=5, batch_size=128,
+        lr=0.004, clip_grad_norm=1.0, genuine_rate=0.5,
+        train_size=20000, test_size=4000,
+        attacks=(AttackSpec(mode="LIE", num_clients=25, attack_round=2,
+                            args=(0.74,)),),
+        log_path="/tmp/afl_fp", checkpoint_dir="/tmp/afl_fp",
+    )
+    sim = Simulator(cfg)
+    t0 = time.time()
+    state, hist = sim.run_fast(save_checkpoints=False, verbose=True)
+    total = time.time() - t0
+    ok = sum(1 for h in hist if h["ok"])
+    # steady state: exclude the first chunk's compile via chunk timings
+    chunks: dict[float, int] = {}
+    for h in hist:
+        chunks[h["chunk_seconds"]] = h["chunk_len"]
+    chunk_items = sorted(chunks.items(), key=lambda kv: -kv[0])
+    steady_s = sum(s for s, _ in chunk_items[1:])
+    steady_rounds = sum(n for _, n in chunk_items[1:])
+    out = {
+        "config": "BASELINE config 4 at full scale (100 clients, 25 LIE)",
+        "rounds": len(hist), "ok_rounds": ok,
+        "final_roc_auc": round(float(hist[-1].get("roc_auc", float("nan"))), 4),
+        "total_s": round(total, 1),
+        "rounds_per_sec_incl_compile": round(len(hist) / total, 4),
+        "rounds_per_sec_steady": (round(steady_rounds / steady_s, 4)
+                                  if steady_s > 0 else None),
+    }
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
