@@ -670,6 +670,11 @@ class Simulator:
             first_dispatch = False
             t0 = time.perf_counter()
             state, metrics = self.run_scan(state, n)
+            # dispatch is ASYNC (CPU backend included): without blocking,
+            # `elapsed` measures enqueue time (~10 ms) while the actual
+            # rounds run inside the np.asarray sync below, making
+            # chunk_seconds fiction.  Block inside the timed section.
+            jax.block_until_ready(metrics)
             elapsed = time.perf_counter() - t0
             host = {k: np.asarray(v) for k, v in metrics.items()}
             for i in range(n):

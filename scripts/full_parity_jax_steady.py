@@ -1,0 +1,82 @@
+"""Steady-state rounds/s of the full-scale config-4 JAX program.
+
+Complements scripts/full_parity_jax.py (which reports honest end-to-end
+wall time incl. compile): fixed chunk_size=5 compiles ONE 5-round fused
+program, then times cached dispatches with the engine's block-until-ready
+chunk timing — the genuine steady rate the reference comparison needs
+(the torch side has no compile phase to exclude).
+
+Usage: python -u scripts/full_parity_jax_steady.py [--rounds 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--chunk", type=int, default=5)
+    ap.add_argument("--out", type=str,
+                    default=str(Path(__file__).resolve().parent.parent
+                                / "FULL_PARITY_JAX_STEADY.json"))
+    args = ap.parse_args()
+
+    from attackfl_tpu.config import AttackSpec, Config
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = Config(
+        num_round=args.rounds, total_clients=100, mode="fedavg",
+        model="TransformerModel", data_name="ICU",
+        num_data_range=(12000, 15000), epochs=5, batch_size=128,
+        lr=0.004, clip_grad_norm=1.0, genuine_rate=0.5,
+        train_size=20000, test_size=4000,
+        attacks=(AttackSpec(mode="LIE", num_clients=25, attack_round=2,
+                            args=(0.74,)),),
+        log_path="/tmp/afl_fps", checkpoint_dir="/tmp/afl_fps",
+    )
+    sim = Simulator(cfg)
+    t0 = time.time()
+    state, hist = sim.run_fast(save_checkpoints=False, verbose=True,
+                               chunk_size=args.chunk)
+    total = time.time() - t0
+    chunk_times: list[tuple[float, int]] = []
+    seen: set[float] = set()
+    for h in hist:
+        if h["chunk_seconds"] not in seen:
+            seen.add(h["chunk_seconds"])
+            chunk_times.append((h["chunk_seconds"], h["chunk_len"]))
+    # first chunk carries trace+compile; the rest are cached dispatches
+    steady = chunk_times[1:]
+    steady_s = sum(s for s, _ in steady)
+    steady_rounds = sum(n for _, n in steady)
+    out = {
+        "config": "config 4 full scale, chunked steady-state",
+        "rounds": len(hist),
+        "ok_rounds": sum(1 for h in hist if h["ok"]),
+        "final_roc_auc": round(float(hist[-1].get("roc_auc", float("nan"))), 4),
+        "total_s": round(total, 1),
+        "first_chunk_s_incl_compile": round(chunk_times[0][0], 2),
+        "steady_chunks": [[round(s, 2), n] for s, n in steady],
+        "rounds_per_sec_steady": (round(steady_rounds / steady_s, 4)
+                                  if steady_s else None),
+        "seconds_per_round_steady": (round(steady_s / steady_rounds, 3)
+                                     if steady_rounds else None),
+    }
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
