@@ -134,7 +134,16 @@ def server_main(argv=None) -> None:
 
     if args.device:
         import jax
-        jax.config.update("jax_platforms", args.device)
+        device = args.device
+        if device == "tpu":
+            # "tpu" is the user-facing name (reference CLI parity:
+            # /root/reference/server.py:38), but a TPU plugin may register
+            # under another platform name — this image's tunnel registers
+            # as "axon", and forcing jax_platforms="tpu" would fail
+            # backend init on exactly the hardware the flag targets.
+            from attackfl_tpu.parallel.mesh import resolve_tpu_platform
+            device = resolve_tpu_platform()
+        jax.config.update("jax_platforms", device)
 
     if args.coordinator:
         if not args.no_wait:

@@ -25,6 +25,46 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+#: Platform names that mean "TPU silicon" — the single place to update on
+#: the next plugin rename (consumed by is_tpu_backend, the out-of-process
+#: probe checks in scripts/measure_baseline.py + scripts/tpu_watch.sh, and
+#: cli.py's --device tpu resolution).  Ordered most-specific first: the
+#: stock "tpu" factory is registered even on machines with no TPU, so
+#: resolution-by-registered-factory must try the plugin names before it.
+TPU_PLATFORMS = ("axon", "tpu")
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is TPU silicon.
+
+    The tunnel this image uses registers its PJRT plugin under the platform
+    name ``"axon"`` (aliased to the canonical ``"tpu"`` only inside MLIR
+    lowering), so ``jax.default_backend()`` returns ``"axon"`` — never
+    ``"tpu"`` — on the real chip.  Every "am I on TPU?" gate must go
+    through this helper: comparing against the literal ``"tpu"`` silently
+    disables TPU-only paths (compiled Pallas, bf16 variants, north-star
+    scale) on exactly the hardware they exist for.
+    """
+    return jax.default_backend() in TPU_PLATFORMS
+
+
+def resolve_tpu_platform() -> str:
+    """Map the user-facing ``--device tpu`` to the platform name the
+    installed TPU plugin actually registered under.
+
+    Peeks jax's registered backend *factories* (populated at plugin
+    discovery, well before backend init, so this never touches the
+    tunnel).  TPU_PLATFORMS is ordered plugin-names-first because the
+    stock "tpu" factory is registered even on TPU-less machines."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        registered = set(_xb._backend_factories)
+    except Exception:  # private API moved — keep the user's word
+        registered = set()
+    return next((p for p in TPU_PLATFORMS if p in registered), "tpu")
+
+
 def distributed_init(coordinator: str, num_processes: int, process_id: int) -> None:
     """Join the JAX distributed runtime: the DCN scale-out entry point.
 

@@ -130,7 +130,13 @@ def build_round_step(
         from attackfl_tpu.ops import fused_step
         from attackfl_tpu.utils.logging import print_with_color
 
-        interpret = jax.default_backend() != "tpu"
+        from attackfl_tpu.parallel.mesh import is_tpu_backend
+
+        # NOT a literal 'backend == "tpu"' check: the axon tunnel's
+        # platform name is "axon", and that literal comparison silently
+        # forced interpret mode on the real chip (rounds 1-3 never ran
+        # the compiled kernel because of it).
+        interpret = not is_tpu_backend()
         if interpret:
             print_with_color(
                 "[pallas] no TPU backend: running the fused kernel in "

@@ -5,8 +5,10 @@ synthetic arrays (make_dataset seed 1 / test seed 10001 — Config defaults),
 same reference hyperparameters (100 clients, 25 LIE attackers z=0.74 from
 round 2, 5 epochs, batch 128, lr 0.004, clip 1.0, 12-15k samples/client/
 round, genuine-rate 0.5), 30 rounds.  Prints one JSON line with final
-ROC-AUC and steady-state + incl-compile rounds/s; paste next to the torch
-line in BASELINE.md.
+ROC-AUC and the honest end-to-end incl-compile rounds/s; paste next to the
+torch line in BASELINE.md.  The steady-state (cached-dispatch) rate is a
+separate measurement: scripts/full_parity_jax_steady.py, which imports
+:func:`full_scale_config` from here so the two runs can never drift apart.
 
 Usage: python -u scripts/full_parity_jax.py [--rounds 30] [--out FULL_PARITY_JAX.json]
 """
@@ -26,6 +28,28 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")  # same-host claim => same CPU
 
 
+def full_scale_config(rounds: int, log_path: str = "/tmp/afl_fp"):
+    """The exact workload of ``torch_parity.run(4, clients=100, ...)`` —
+    shared with full_parity_jax_steady.py so the end-to-end and steady
+    measurements are guaranteed to be the same program.
+
+    Derived from ``bench.make_config(4)`` (the single source of the
+    reference hyperparameters) with the parity deltas stated explicitly:
+    25 LIE attackers (torch_parity scales attackers to 25% of clients,
+    vs bench's 20) and scan_unroll=1 (what the committed
+    FULL_PARITY_JAX.json end-to-end run executed; bench tunes 4)."""
+    import bench
+    from attackfl_tpu.config import AttackSpec
+
+    return bench.make_config(4, log_path).replace(
+        num_round=rounds,
+        scan_unroll=1,
+        attacks=(AttackSpec(mode="LIE", num_clients=25, attack_round=2,
+                            args=(0.74,)),),
+        checkpoint_dir=log_path,
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=30)
@@ -34,19 +58,9 @@ def main() -> None:
                                 / "FULL_PARITY_JAX.json"))
     args = ap.parse_args()
 
-    from attackfl_tpu.config import AttackSpec, Config
     from attackfl_tpu.training.engine import Simulator
 
-    cfg = Config(
-        num_round=args.rounds, total_clients=100, mode="fedavg",
-        model="TransformerModel", data_name="ICU",
-        num_data_range=(12000, 15000), epochs=5, batch_size=128,
-        lr=0.004, clip_grad_norm=1.0, genuine_rate=0.5,
-        train_size=20000, test_size=4000,
-        attacks=(AttackSpec(mode="LIE", num_clients=25, attack_round=2,
-                            args=(0.74,)),),
-        log_path="/tmp/afl_fp", checkpoint_dir="/tmp/afl_fp",
-    )
+    cfg = full_scale_config(args.rounds)
     sim = Simulator(cfg)
     t0 = time.time()
     state, hist = sim.run_fast(save_checkpoints=False, verbose=True)

@@ -33,32 +33,27 @@ def main() -> None:
                                 / "FULL_PARITY_JAX_STEADY.json"))
     args = ap.parse_args()
 
-    from attackfl_tpu.config import AttackSpec, Config
     from attackfl_tpu.training.engine import Simulator
+    from full_parity_jax import full_scale_config
 
-    cfg = Config(
-        num_round=args.rounds, total_clients=100, mode="fedavg",
-        model="TransformerModel", data_name="ICU",
-        num_data_range=(12000, 15000), epochs=5, batch_size=128,
-        lr=0.004, clip_grad_norm=1.0, genuine_rate=0.5,
-        train_size=20000, test_size=4000,
-        attacks=(AttackSpec(mode="LIE", num_clients=25, attack_round=2,
-                            args=(0.74,)),),
-        log_path="/tmp/afl_fps", checkpoint_dir="/tmp/afl_fps",
-    )
+    cfg = full_scale_config(args.rounds, "/tmp/afl_fps")
     sim = Simulator(cfg)
     t0 = time.time()
     state, hist = sim.run_fast(save_checkpoints=False, verbose=True,
                                chunk_size=args.chunk)
     total = time.time() - t0
+    # group rounds into their dispatch chunks BY POSITION (chunk_len is
+    # recorded on every round of a chunk) — not by float-equality of
+    # chunk_seconds, which would merge chunks on a timing collision
     chunk_times: list[tuple[float, int]] = []
-    seen: set[float] = set()
-    for h in hist:
-        if h["chunk_seconds"] not in seen:
-            seen.add(h["chunk_seconds"])
-            chunk_times.append((h["chunk_seconds"], h["chunk_len"]))
-    # first chunk carries trace+compile; the rest are cached dispatches
-    steady = chunk_times[1:]
+    i = 0
+    while i < len(hist):
+        n = int(hist[i]["chunk_len"])
+        chunk_times.append((hist[i]["chunk_seconds"], n))
+        i += n
+    # first chunk carries trace+compile; a tail chunk shorter than --chunk
+    # is a NEW program shape (fresh compile) and must not count as steady
+    steady = [(s, n) for s, n in chunk_times[1:] if n == args.chunk]
     steady_s = sum(s for s, _ in steady)
     steady_rounds = sum(n for _, n in steady)
     out = {

@@ -30,6 +30,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 PROBE_SNIPPET = """
 import json, bench
@@ -87,7 +88,13 @@ def main() -> None:
 
     out: dict = {"probe": run_step([py, "-c", PROBE_SNIPPET], 660.0)}
     print(json.dumps({"probe": out["probe"]}), flush=True)
-    if out["probe"].get("backend") != "tpu":
+    # the axon tunnel's platform name is "axon", not "tpu" — the literal
+    # "tpu" comparison used here through round 3 skipped the pallas and
+    # north-star rows on the live chip (single source: mesh.TPU_PLATFORMS;
+    # importing it pulls in jax but does not initialize any backend)
+    from attackfl_tpu.parallel.mesh import TPU_PLATFORMS
+
+    if out["probe"].get("backend") not in TPU_PLATFORMS:
         skip |= {"config4_pallas", "north_star_1000c"}
         out["note"] = "off-TPU: pallas + north-star steps auto-skipped"
 

@@ -116,14 +116,26 @@ def main() -> None:
                     help="north star with full reference sample counts")
     ap.add_argument("--cifar-clients", type=int, default=64)
     ap.add_argument("--skip-cifar", action="store_true")
+    ap.add_argument("--skip-northstar", action="store_true",
+                    help="rerun only the CIFAR ceiling (e.g. after a kill "
+                         "mid-run); merges into an existing --out file")
     ap.add_argument("--out", type=str,
                     default=str(Path(__file__).resolve().parent.parent
                                 / "NORTHSTAR_CPU.json"))
     args = ap.parse_args()
 
     out: dict = {"host": "cpu-1core-virtual8mesh"}
-    out["north_star_shape"] = run_northstar(args.rounds, args.full)
-    print(json.dumps({"north_star_shape": out["north_star_shape"]}), flush=True)
+    if args.skip_northstar:
+        if not Path(args.out).exists():
+            sys.exit(f"--skip-northstar merges into an existing {args.out}, "
+                     "which does not exist — run without the flag first "
+                     "(otherwise the artifact would silently lose its "
+                     "north_star_shape evidence)")
+        out.update(json.loads(Path(args.out).read_text()))
+    else:
+        out["north_star_shape"] = run_northstar(args.rounds, args.full)
+        print(json.dumps({"north_star_shape": out["north_star_shape"]}),
+              flush=True)
     if not args.skip_cifar:
         out["cifar_ceiling"] = run_cifar_ceiling(args.cifar_clients, args.rounds)
         print(json.dumps({"cifar_ceiling": out["cifar_ceiling"]}), flush=True)

@@ -13,16 +13,20 @@ PROBE='
 import time, json
 t0 = time.time()
 import jax, jax.numpy as jnp
+from attackfl_tpu.parallel.mesh import is_tpu_backend
 x = jnp.ones((256, 256))
 y = (x @ x).block_until_ready()
-print(json.dumps({"ok": True, "backend": jax.default_backend(),
+print(json.dumps({"ok": is_tpu_backend(), "backend": jax.default_backend(),
                   "device": str(jax.devices()[0]), "init_s": round(time.time()-t0, 1)}))
 '
 echo "$(date -u +%FT%TZ) watchdog start interval=${INTERVAL}s" >> "$LOG"
 while true; do
   OUT=$(timeout 300 python -c "$PROBE" 2>&1 | tail -1)
   TS=$(date -u +%FT%TZ)
-  if echo "$OUT" | grep -q '"backend": "tpu"'; then
+  # "ok" is true only when the probe ran on a mesh.TPU_PLATFORMS backend
+  # (the axon tunnel registers as platform 'axon', not 'tpu' — the original
+  # check for '"backend": "tpu"' could never match a live tunnel).
+  if echo "$OUT" | grep -q '"ok": true'; then
     echo "$TS PROBE OK $OUT" >> "$LOG"
     echo "$TS launching measure_baseline.py" >> "$LOG"
     python scripts/measure_baseline.py --out baseline_rows.json \

@@ -27,6 +27,36 @@ def test_north_star_geometry():
     assert sum(a.num_clients for a in cfg.attacks) == 200  # 20% LIE
 
 
+def test_is_tpu_backend_accepts_axon(monkeypatch):
+    """The tunnel's platform name is "axon", not "tpu" — the literal
+    comparison this helper replaced disabled every TPU-only path (compiled
+    Pallas, bf16 variant, north star) on the real chip through round 3."""
+    import jax
+
+    from attackfl_tpu.parallel import mesh
+
+    for name, expect in (("tpu", True), ("axon", True),
+                         ("cpu", False), ("gpu", False)):
+        monkeypatch.setattr(jax, "default_backend", lambda n=name: n)
+        assert mesh.is_tpu_backend() is expect
+
+
+def test_resolve_tpu_platform_prefers_registered_plugin():
+    """--device tpu must resolve to the plugin's actual platform name:
+    on this image the factories are {cpu, tpu, axon} and "axon" (the
+    tunnel) must win over the stock "tpu" factory, which is registered
+    even on TPU-less machines."""
+    from jax._src import xla_bridge as xb
+
+    from attackfl_tpu.parallel import mesh
+
+    resolved = mesh.resolve_tpu_platform()
+    if "axon" in xb._backend_factories:
+        assert resolved == "axon"
+    else:
+        assert resolved == "tpu"
+
+
 def test_measure_fused_and_host_paths(tmp_path):
     """measure() returns rounds/s + final metric on both code paths
     (fused scan vs per-round host loop)."""
