@@ -59,7 +59,11 @@ def run_northstar(rounds: int, full: bool) -> dict:
     sim = Simulator(cfg, use_mesh=True)
     assert sim.mesh is not None and sim.mesh.size == 8
     t0 = time.time()
-    state, hist = sim.run_fast(save_checkpoints=False, verbose=True)
+    # chunk_size=1 for the same observability reason as run_cifar_ceiling
+    # below: at CPU speeds a whole-run fused dispatch is hours of silence
+    # with no partial evidence if it wedges or is killed
+    state, hist = sim.run_fast(save_checkpoints=False, verbose=True,
+                               chunk_size=1)
     total = time.time() - t0
     return {
         "clients": cfg.total_clients,
@@ -89,7 +93,13 @@ def run_cifar_ceiling(clients: int, rounds: int) -> dict:
                  log_path="/tmp/afl_ns", checkpoint_dir="/tmp/afl_ns")
     sim = Simulator(cfg, use_mesh=True)
     t0 = time.time()
-    state, hist = sim.run_fast(save_checkpoints=False, verbose=True)
+    # chunk_size=1: a multi-round fused ResNet dispatch emits nothing until
+    # the whole chunk completes — at CPU speeds that is hours of silence
+    # (the 64-client attempt died unobservable inside one 3-round chunk,
+    # BASELINE.md); per-round chunks trade a sliver of dispatch overhead
+    # for per-round progress and per-round wall times
+    state, hist = sim.run_fast(save_checkpoints=False, verbose=True,
+                               chunk_size=1)
     total = time.time() - t0
     # measured resident footprint of the stacked client axis, scaled to
     # the 1000-client question the BASELINE config-5 note asserts
