@@ -32,6 +32,9 @@ AGGREGATION_MODES = (
     "median",
     "scionfl",
     "fltracer",
+    # byzantine_tolerance_aggregation (Utils.py:228-248) — also dead in the
+    # reference (imported at server.py:25, never dispatched), live here.
+    "byzantine",
 )
 
 ATTACK_MODES = ("Random", "Min-Max", "Min-Sum", "Opt-Fang", "LIE")
@@ -165,6 +168,8 @@ class Config:
     # let users set the real byzantine count.
     krum_f: int = 0
     trim_ratio: float = 0.1  # trimmed-mean (Utils.py:267)
+    # cosine-vs-anchor keep threshold for mode "byzantine" (Utils.py:228)
+    byzantine_threshold: float = 0.9
     # PRNG implementation for simulation keys.  "rbg" (hardware random-bit
     # generator) makes per-batch dropout-mask generation ~4x cheaper on TPU
     # than counter-based "threefry"; streams differ between impls but both
@@ -353,6 +358,8 @@ def config_from_dict(raw: dict) -> Config:
         local_backend=str(_get(mesh, "local-backend", defaults.local_backend)),
         krum_f=int(_get(server, "krum-f", defaults.krum_f)),
         trim_ratio=float(_get(server, "trim-ratio", defaults.trim_ratio)),
+        byzantine_threshold=float(
+            _get(server, "byzantine-threshold", defaults.byzantine_threshold)),
         train_size=int(_get(server, "train-size", defaults.train_size)),
         test_size=int(_get(server, "test-size", defaults.test_size)),
     )

@@ -188,6 +188,37 @@ def shieldfl(stacked: Any, eps: float = 1e-6,
     return pt.tree_weighted_mean(stacked, weights)
 
 
+def byzantine_tolerance(stacked: Any, threshold: float = 0.9,
+                        mask: jnp.ndarray | None = None) -> Any:
+    """Cosine-threshold filter + unweighted mean (reference:
+    byzantine_tolerance_aggregation, src/Utils.py:228-248 — dead code there,
+    imported at server.py:25 but never dispatched; live here as mode
+    "byzantine" for completeness, like the fltracer branch).
+
+    Reference semantics kept exactly: the FIRST model is the trusted
+    anchor ("Giả sử mô hình đầu tiên là mô hình gốc" — assume the first is
+    the original); keep clients whose flat-vector cosine vs the anchor is
+    ``>= threshold`` (the anchor always keeps itself at cos 1.0); if the
+    filter empties, fall back to ALL models; average the survivors
+    UNWEIGHTED (sum/len over state_dict keys).
+
+    With ``mask`` (C,), dropped clients cannot be the anchor (it moves to
+    the first valid row) and are zero-weighted; the fallback is to all
+    *valid* clients.  Soft-mask weighting keeps shapes static.
+    """
+    flat = pt.tree_ravel_stacked(stacked)  # (N, P)
+    if mask is None:
+        maskf = jnp.ones((flat.shape[0],), flat.dtype)
+    else:
+        maskf = mask.astype(flat.dtype)
+    anchor = flat[jnp.argmax(maskf)]  # first valid client (0 when unmasked)
+    cos = (flat @ anchor) / (
+        jnp.linalg.norm(flat, axis=1) * jnp.linalg.norm(anchor) + 1e-12)
+    keep = (cos >= threshold).astype(flat.dtype) * maskf
+    keep = jnp.where(jnp.sum(keep) > 0, keep, maskf)
+    return pt.tree_weighted_mean(stacked, keep)
+
+
 # ---------------------------------------------------------------------------
 # ScionFL
 # ---------------------------------------------------------------------------

@@ -301,6 +301,11 @@ def build_aggregator(
     elif mode == "scionfl":
         def aggregate(global_params, stacked, sizes, weights_mask, rng):
             return aggregators.scionfl(stacked, sizes.astype(jnp.float32) * weights_mask, rng)
+    elif mode == "byzantine":
+        def aggregate(global_params, stacked, sizes, weights_mask, rng):
+            return aggregators.byzantine_tolerance(
+                stacked, cfg.byzantine_threshold,
+                weights_mask if geo_mask else None)
     elif mode == "FLTrust":
         if test_data is None:
             raise ValueError("FLTrust requires test data for root training")

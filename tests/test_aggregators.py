@@ -127,6 +127,53 @@ def test_mean_aggregation():
 
 
 # ---------------------------------------------------------------------------
+# byzantine_tolerance (reference: Utils.py:228-248) — closed-form semantics.
+# ---------------------------------------------------------------------------
+
+def test_byzantine_tolerance_closed_form():
+    """Client 0 is the anchor; keep cos >= threshold; unweighted mean of
+    the survivors (Utils.py:232-246)."""
+    clients = np.array([
+        [1.0, 0.0, 0.0],   # anchor, cos 1.0 with itself -> always kept
+        [2.0, 0.1, 0.0],   # nearly aligned, cos ~0.999 -> kept
+        [0.0, 5.0, 0.0],   # orthogonal, cos 0 -> filtered
+        [-1.0, 0.0, 0.0],  # anti-aligned, cos -1 -> filtered
+    ], np.float32)
+    t = {"w": jnp.asarray(clients)}
+    out = np.asarray(agg.byzantine_tolerance(t, threshold=0.9)["w"])
+    np.testing.assert_allclose(out, clients[[0, 1]].mean(0), rtol=1e-5)
+
+
+def test_byzantine_tolerance_fallback_to_all():
+    """An impossible threshold empties the filter (even the anchor's own
+    cos 1.0 fails) -> fall back to the unweighted mean of ALL models
+    (Utils.py:239-241)."""
+    t = stacked_tree(5, seed=11)
+    out = agg.byzantine_tolerance(t, threshold=1.1)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(out[k]),
+                                   np.asarray(t[k]).mean(0), rtol=1e-5)
+
+
+def test_byzantine_tolerance_masked_equals_subset():
+    """With a participation mask the anchor moves to the first VALID row
+    and the result equals the unmasked rule over the valid subset."""
+    clients = np.array([
+        [9.0, 9.0, 9.0],   # masked out — must not become the anchor
+        [1.0, 0.0, 0.0],   # first valid -> anchor
+        [2.0, 0.05, 0.0],  # kept
+        [0.0, 4.0, 0.0],   # filtered
+    ], np.float32)
+    t = {"w": jnp.asarray(clients)}
+    mask = jnp.asarray([0, 1, 1, 1], jnp.float32)
+    got = np.asarray(jax.jit(
+        lambda t, m: agg.byzantine_tolerance(t, 0.9, m))(t, mask)["w"])
+    want = np.asarray(agg.byzantine_tolerance(
+        _subset(t, np.array([1, 2, 3])), 0.9)["w"])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
 # Masked (reporters-only) variants — straggler exclusion, ADVICE r3 #2.
 # Invariant: masked aggregation over C rows == unmasked aggregation over the
 # valid rows only, with static shapes (checked under jit).
@@ -239,3 +286,30 @@ def test_masked_aggregators_propagate_valid_nonfinite():
     mask2 = jnp.asarray([0, 1, 1, 1, 1], jnp.float32)
     sel2 = int(agg.krum_select(t2, 0, mask2))
     assert sel2 in (1, 3, 4), sel2  # valid, not masked(0), not diverged(2)
+
+
+@pytest.mark.parametrize("n_bad", [2, 3])
+@pytest.mark.parametrize("seed", range(8))
+def test_masked_krum_multi_diverged_property(n_bad, seed):
+    """Property pin for the asserted-not-derived edge case
+    (ops/aggregators.py krum_select diverged-client guard): with SEVERAL
+    non-finite clients and a random participation mask, the selected index
+    must always be (a) finite and (b) unmasked — the uniformly-deflated
+    innocent scores may reorder innocents, but never admit a diverged or
+    dropped row."""
+    r = np.random.default_rng(100 + seed)
+    n = 9
+    clients = r.normal(size=(n, 6)).astype(np.float32)
+    bad = r.choice(n, size=n_bad, replace=False)
+    for i, b in enumerate(bad):
+        clients[b, i % 6] = [np.inf, -np.inf, np.nan][i % 3]
+    # random mask that always keeps >= 3 finite clients (so a valid
+    # selection exists); diverged clients may be masked or not
+    finite_rows = np.setdiff1d(np.arange(n), bad)
+    keep_finite = r.choice(finite_rows, size=3, replace=False)
+    mask_np = (r.random(n) > 0.4).astype(np.float32)
+    mask_np[keep_finite] = 1.0
+    t = {"w": jnp.asarray(clients)}
+    sel = int(jax.jit(agg.krum_select)(t, 0, jnp.asarray(mask_np)))
+    assert np.all(np.isfinite(clients[sel])), (sel, bad, mask_np)
+    assert mask_np[sel] == 1.0, (sel, bad, mask_np)

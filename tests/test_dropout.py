@@ -144,7 +144,8 @@ def test_config_validation_and_yaml():
     assert c.client_dropout_rate == 0.2
 
 
-@pytest.mark.parametrize("mode", ["median", "trimmed_mean", "krum", "shieldfl"])
+@pytest.mark.parametrize("mode", ["median", "trimmed_mean", "krum", "shieldfl",
+                                  "byzantine"])
 def test_dropout_geometric_modes_reporters_only(mode):
     """With dropout configured, geometric aggregators exclude dropped rows
     (reporters-only; ADVICE r3 #2): the new global equals the unmasked
@@ -167,7 +168,9 @@ def test_dropout_geometric_modes_reporters_only(mode):
     want = {"median": lambda: agg.median_aggregation(sub),
             "trimmed_mean": lambda: agg.trimmed_mean(sub, cfg.trim_ratio),
             "krum": lambda: agg.krum(sub, cfg.krum_f),
-            "shieldfl": lambda: agg.shieldfl(sub)}[mode]()
+            "shieldfl": lambda: agg.shieldfl(sub),
+            "byzantine": lambda: agg.byzantine_tolerance(
+                sub, cfg.byzantine_threshold)}[mode]()
     for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5,
                                    atol=1e-6)

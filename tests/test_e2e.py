@@ -103,7 +103,7 @@ def test_non_iid_partition_runs():
 @pytest.mark.slow
 def test_min_max_attack_with_defense_modes():
     atk = (AttackSpec(mode="Min-Max", num_clients=1, attack_round=2),)
-    for mode in ("krum", "shieldfl"):
+    for mode in ("krum", "shieldfl", "byzantine"):
         cfg = Config(num_round=2, total_clients=5, mode=mode, attacks=atk, **BASE)
         _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
         assert all(h["ok"] for h in hist), mode
