@@ -107,6 +107,17 @@ class Config:
     model: str = "TransformerModel"
     data_name: str = "ICU"
     load_parameters: bool = False
+    # Reference fidelity quirk (server.py:578-586): with parameters.load
+    # True the reference re-reads {model}.pth before EVERY broadcast of a
+    # non-hyper round.  It also rewrites that file after every successful
+    # round (server.py:550-553), so the save→re-read round-trip is how the
+    # aggregate reaches clients — and after a FAILED round the re-read
+    # restores the last saved params.  Default False keeps this
+    # framework's load-once-resume semantics; opt in to replicate the
+    # per-broadcast re-read (pair with per-round checkpoint saving for the
+    # full reference cycle; missing file = no-op, like the reference's
+    # os.path.exists gate).
+    reload_parameters_per_round: bool = False
     validation: bool = True
     num_data_range: tuple[int, int] = (12000, 15000)
     genuine_rate: float = 0.5
@@ -200,6 +211,12 @@ class Config:
             )
         if self.scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.reload_parameters_per_round and not self.load_parameters:
+            raise ValueError(
+                "reload_parameters_per_round replicates the reference's "
+                "per-broadcast re-read, which is gated on parameters.load "
+                "(server.py:580) — set load_parameters=True as well"
+            )
         if self.mesh.compute_dtype not in ("float32", "bfloat16", "float16"):
             raise ValueError(
                 f"Unknown compute-dtype {self.mesh.compute_dtype!r}; choose "
@@ -321,6 +338,9 @@ def config_from_dict(raw: dict) -> Config:
         model=str(_get(server, "model", defaults.model)),
         data_name=str(_get(server, "data-name", defaults.data_name)),
         load_parameters=bool(_get(_get(server, "parameters", {}), "load", False)),
+        reload_parameters_per_round=bool(_get(
+            _get(server, "parameters", {}), "reload-per-round",
+            defaults.reload_parameters_per_round)),
         validation=bool(_get(server, "validation", True)),
         num_data_range=(int(ndr[0]), int(ndr[1])),
         genuine_rate=float(_get(server, "genuine-rate", defaults.genuine_rate)),

@@ -55,13 +55,32 @@ def resolve_tpu_platform() -> str:
     Peeks jax's registered backend *factories* (populated at plugin
     discovery, well before backend init, so this never touches the
     tunnel).  TPU_PLATFORMS is ordered plugin-names-first because the
-    stock "tpu" factory is registered even on TPU-less machines."""
+    stock "tpu" factory is registered even on TPU-less machines.
+
+    JAX's entry-point plugin discovery can run lazily inside
+    ``backends()`` (this image's plugin registers at ``import jax``, but
+    that is an image property, not a JAX guarantee — ADVICE r4 #1), so
+    force discovery first and also consult the ``jax_plugins`` entry
+    points directly; otherwise a lazily-registered plugin name would be
+    invisible here and ``--device tpu`` would silently resolve to the
+    stock "tpu" platform on exactly the hardware the plugin serves."""
+    registered: set[str] = set()
     try:
         from jax._src import xla_bridge as _xb
 
-        registered = set(_xb._backend_factories)
+        try:  # idempotent; registers entry-point plugins without backend init
+            _xb.discover_pjrt_plugins()
+        except Exception:
+            pass
+        registered |= set(_xb._backend_factories)
     except Exception:  # private API moved — keep the user's word
-        registered = set()
+        pass
+    try:
+        from importlib.metadata import entry_points
+
+        registered |= {ep.name for ep in entry_points(group="jax_plugins")}
+    except Exception:
+        pass
     return next((p for p in TPU_PLATFORMS if p in registered), "tpu")
 
 

@@ -125,6 +125,13 @@ class Simulator:
                 "stats and needs the full client matrix locally; run it "
                 "single-process (the matrices are tiny — SURVEY.md §7)"
             )
+        if self.multiprocess and cfg.reload_parameters_per_round:
+            raise ValueError(
+                "reload_parameters_per_round re-reads a host-local file "
+                "each round; under DCN every process would race its own "
+                "copy — run it single-process (the reference it replicates "
+                "is single-server, server.py:578-586)"
+            )
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
         # ---- validation -------------------------------------------------
@@ -294,6 +301,21 @@ class Simulator:
         """
         cfg = self.cfg
         t0 = time.perf_counter()
+        if cfg.reload_parameters_per_round and not self.is_hyper:
+            # reference fidelity (server.py:578-586): with parameters.load,
+            # every non-hyper broadcast re-reads the checkpoint file.  The
+            # reference also REWRITES that file after every successful
+            # round (server.py:550-553), so there the round-trip is how the
+            # aggregate reaches clients — replicate it with per-round
+            # checkpoint saving on (run(save_checkpoints=True)); with
+            # saving off this pins training to the file's params instead.
+            # A missing file is a no-op (os.path.exists gate).
+            path = ckpt.checkpoint_path(cfg)
+            try:
+                fresh = ckpt.load_state(path, state)
+                state = dict(state, global_params=fresh["global_params"])
+            except FileNotFoundError:
+                pass
         rng, k_round, k_agg = jax.random.split(state["rng"], 3)
         broadcast_number = int(state["broadcasts"]) + 1
         metrics: dict[str, Any] = {"round": int(state["completed_rounds"]) + 1,
@@ -461,6 +483,11 @@ class Simulator:
         if self.cfg.mode in ("gmm", "fltracer"):
             return False
         if self.is_hyper and self.detector is not None:
+            return False
+        if self.cfg.reload_parameters_per_round and not self.is_hyper:
+            # re-reads a file on host before every broadcast (hyper mode
+            # never reloads — reference gate server.py:580 — so it keeps
+            # the fused path)
             return False
         return True
 

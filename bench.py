@@ -103,20 +103,28 @@ def tpu_init_watchdog(metric: str, seconds: float = 600.0):
 
     def _boom():
         if not done.is_set():
-            # a dead tunnel must not leave the record contentless: inline
-            # the committed same-host CPU evidence (BASELINE.md) so the
-            # bench artifact documents what HAS been measured
+            # a dead tunnel must not leave the record contentless: point at
+            # the committed same-host CPU evidence (BASELINE.md) with ONE
+            # headline number per artifact — inlining the full files would
+            # grow the one-line JSON contract without bound and duplicate
+            # data already committed in the repo (ADVICE r4 #3)
             evidence = {}
             from pathlib import Path
+            headline_keys = ("rounds_per_sec", "rounds_per_sec_steady",
+                             "rounds_per_sec_incl_compile", "final_roc_auc",
+                             "jax_final_accuracy", "torch_final_accuracy")
             for p in ("parity_full_torch.json", "FULL_PARITY_JAX.json",
                       "FULL_PARITY_JAX_STEADY.json", "NORTHSTAR_CPU.json",
                       "HAR_PARITY.json"):
                 f = Path(__file__).parent / p
                 if f.exists():
                     try:
-                        evidence[p] = json.loads(f.read_text())
+                        data = json.loads(f.read_text())
                     except ValueError:
-                        pass
+                        continue
+                    evidence[p] = {k: data[k] for k in headline_keys
+                                   if isinstance(data, dict) and k in data
+                                   and isinstance(data[k], (int, float))}
             detail = {
                 "error": "TPU backend init did not complete "
                          f"within {seconds:.0f}s (axon tunnel down?)",

@@ -18,6 +18,10 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# the sibling-module import (full_parity_jax) must not depend on Python's
+# implicit script-dir path entry, which is absent under `python -m
+# scripts.full_parity_jax_steady` or an external import (ADVICE r4 #2)
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import jax  # noqa: E402
 

@@ -67,6 +67,53 @@ def test_checkpoint_resume(tmp_path):
     assert len([h for h in hist2 if h["ok"]]) == 2  # only the remainder ran
 
 
+def test_reload_parameters_per_round(tmp_path):
+    """Reference quirk replicated opt-in (server.py:578-586): with
+    parameters.load + reload-per-round, EVERY broadcast re-reads the
+    checkpoint file (the reference pairs this with a per-round save of the
+    aggregate to the same file, server.py:550-553 — here checkpoints are
+    NOT saved, so each round restarts from the same file).  Round 2 of a
+    reload run must equal a manual run whose params are reset to the
+    file's params between rounds (same seed => same rng streams)."""
+    base = dict(BASE)
+    base.update(log_path=str(tmp_path), checkpoint_dir=str(tmp_path))
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg", **base)
+    sim = Simulator(cfg)
+    sim.run(save_checkpoints=True, verbose=False)  # writes the .pth analog
+    file_params = ckpt.load_state(
+        ckpt.checkpoint_path(cfg), sim.init_state())["global_params"]
+
+    reload_cfg = cfg.replace(num_round=3, load_parameters=True,
+                             reload_parameters_per_round=True)
+    simA = Simulator(reload_cfg)
+    stateA = simA.load_or_init_state()
+    stateA, _ = simA.run_round(stateA)
+    stateA, _ = simA.run_round(stateA)
+
+    plain_cfg = cfg.replace(num_round=3, load_parameters=True)
+    simB = Simulator(plain_cfg)
+    stateB = simB.load_or_init_state()
+    stateB, _ = simB.run_round(stateB)
+    # manual re-read between rounds = what reload does automatically
+    stateB = dict(stateB, global_params=file_params)
+    stateB, _ = simB.run_round(stateB)
+
+    for a, b in zip(jax.tree.leaves(stateA["global_params"]),
+                    jax.tree.leaves(stateB["global_params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the host-side file read forces the per-round path
+    assert not simA.supports_fused()
+    # ...but hyper mode never reloads (reference gate server.py:580), so
+    # it keeps the fused scan
+    hyper_cfg = cfg.replace(mode="hyper", load_parameters=True,
+                            reload_parameters_per_round=True)
+    assert Simulator(hyper_cfg).supports_fused()
+    # flag without load_parameters is rejected (reference gate)
+    with pytest.raises(ValueError, match="load_parameters"):
+        Config(reload_parameters_per_round=True)
+
+
 def test_hyper_checkpoint_resume_and_class_mismatch(tmp_path):
     """Hyper-mode resume round-trips (hnet + shared-Adam state + rng); a
     checkpoint written under hyper_class=CNNHyper must fail with the
