@@ -1,9 +1,19 @@
-"""HAR parity at a scale where accuracy separates from chance (VERDICT r3
-weak #4: the CI-scale evidence was 0.31 vs 0.32 where chance = 0.167 —
-thin).  Runs BOTH frameworks on the shared synthetic HAR arrays at a
-moderate scale and writes ``HAR_PARITY.json``.
+"""HAR parity in the accuracy-separating mid-range (VERDICT r4 #6: the
+round-4 measurement saturated — JAX 1.000 vs torch 0.999 where chance =
+0.167, and two saturated models agree trivially).
 
-Usage: python -u scripts/har_parity.py [--clients 5] [--rounds 8] [--epochs 3]
+Runs BOTH frameworks on the shared synthetic HAR arrays, records the FULL
+per-round accuracy trajectory on each side, and reports parity both at the
+final round and at a matched mid-range round (the earliest round where the
+JAX accuracy lands in [0.5, 0.95]) — so the evidence survives whether the
+endpoint saturates or not.  Default scale (5 clients, 8 rounds, 2 epochs,
+256-384 samples/client/round) is calibrated from the round-5 trajectory
+probes: 1 epoch hovers near 0.35, 3 epochs saturates to 1.0.
+
+Writes ``HAR_PARITY.json``.  Single-core box: ~1.5-2 h total, JAX side
+first, torch side second.
+
+Usage: python -u scripts/har_parity.py [--clients 5] [--rounds 8] [--epochs 2]
 """
 
 from __future__ import annotations
@@ -20,15 +30,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+MID_LO, MID_HI = 0.5, 0.95
+
+
+def midrange_round(traj: list[float]) -> int | None:
+    """1-based index of the earliest mid-range round, or None."""
+    for i, a in enumerate(traj):
+        if MID_LO <= a <= MID_HI:
+            return i + 1
+    return None
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=5)
     ap.add_argument("--rounds", type=int, default=8)
-    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--train-size", type=int, default=4096)
     ap.add_argument("--test-size", type=int, default=1024)
-    ap.add_argument("--num-data", type=int, nargs=2, default=(384, 512))
+    ap.add_argument("--num-data", type=int, nargs=2, default=(256, 384))
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--out", type=str,
                     default=str(Path(__file__).resolve().parent.parent
@@ -47,9 +67,12 @@ def main() -> None:
                  test_size=args.test_size,
                  log_path="/tmp/afl_har", checkpoint_dir="/tmp/afl_har")
     t0 = time.time()
-    _, hist = Simulator(cfg).run_fast(save_checkpoints=False, verbose=True)
+    # chunk_size=1: one compiled 1-round program reused every round, so the
+    # history carries the per-round accuracy trajectory
+    _, hist = Simulator(cfg).run_fast(save_checkpoints=False, verbose=True,
+                                      chunk_size=1)
     jax_s = time.time() - t0
-    jax_acc = float(hist[-1].get("accuracy", float("nan")))
+    jax_traj = [float(h.get("accuracy", float("nan"))) for h in hist]
 
     t0 = time.time()
     torch_out = torch_parity.run_har(
@@ -57,17 +80,27 @@ def main() -> None:
         batch_size=args.batch_size, num_data_range=ndr,
         train_size=args.train_size, test_size=args.test_size)
     torch_s = time.time() - t0
+    torch_traj = [float(a) for a in torch_out["accuracy_trajectory"]]
 
+    mid = midrange_round(jax_traj)
     out = {
         "scale": {"clients": args.clients, "rounds": args.rounds,
                   "epochs": args.epochs, "train_size": args.train_size,
                   "num_data_range": list(ndr)},
         "chance_accuracy": round(1.0 / 6.0, 4),
-        "jax_final_accuracy": round(jax_acc, 4),
-        "torch_final_accuracy": round(float(torch_out["final_accuracy"]), 4),
+        "jax_trajectory": [round(a, 4) for a in jax_traj],
+        "torch_trajectory": [round(a, 4) for a in torch_traj],
+        "jax_final_accuracy": round(jax_traj[-1], 4),
+        "torch_final_accuracy": round(torch_traj[-1], 4),
         "jax_total_s": round(jax_s, 1),
         "torch_total_s": round(torch_s, 1),
     }
+    if mid is not None and mid <= len(torch_traj):
+        out["midrange_round"] = mid
+        out["jax_midrange_accuracy"] = round(jax_traj[mid - 1], 4)
+        out["torch_midrange_accuracy"] = round(torch_traj[mid - 1], 4)
+        out["midrange_abs_diff"] = round(
+            abs(jax_traj[mid - 1] - torch_traj[mid - 1]), 4)
     Path(args.out).write_text(json.dumps(out, indent=1))
     print(json.dumps(out))
 

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-5 sequential queue for the 1-core box: once the mid-range HAR
+# parity measurement frees the core, validate the new HAR CI assert alone
+# (fail-fast visibility), then run the FULL suite (fast + slow tiers) so
+# round-5 HEAD has a green full-suite record.
+# Usage: bash scripts/round5_queue.sh [har_parity_pid]
+# Pass the measurement's PID to avoid the pgrep pattern race (a queue
+# launched before the measurement starts would sail through; an editor
+# holding the file open would stall it forever).
+set -u
+cd "$(dirname "$0")/.."
+LOG=round5_queue.log
+echo "queue start $(date -u +%FT%TZ)" >> "$LOG"
+if [ $# -ge 1 ]; then
+  while kill -0 "$1" 2>/dev/null; do sleep 120; done
+else
+  # fallback: match the python invocation, not the bare path
+  while pgrep -f "python .*scripts/har_parity.py" > /dev/null; do sleep 120; done
+fi
+echo "har_parity done $(date -u +%FT%TZ)" >> "$LOG"
+nice -n 5 python -m pytest tests/test_torch_parity.py::test_parity_har_transformer \
+  -q > har_ci_assert.log 2>&1
+echo "har_ci_assert rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+nice -n 5 python -m pytest tests/ -q > full_suite_r5.log 2>&1
+echo "full_suite rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+echo "QUEUE_DONE $(date -u +%FT%TZ)" >> "$LOG"

@@ -106,15 +106,38 @@ def test_parity_config2_hyper():
     assert abs(jax_auc - torch_out["final_roc_auc"]) < 0.12
 
 
-# HAR-family parity is measured, not CI-asserted: at the reduced scale a
-# CI box can afford (3 clients, 128-192 samples/round, 561-token
-# transformer on CPU), per-round accuracy is chaotic (swings 0.16-0.43
-# between adjacent rounds in both frameworks), so an endpoint assertion
-# is pure noise while costing ~19 min.  One-time measurement at 4 rounds
-# on the shared synthetic arrays: torch_parity.run_har 0.3125 final
-# accuracy vs JAX 0.3164 (chance = 1/6); the exact reproduce command for
-# the torch side is in run_har's docstring.  CI keeps the cheap HAR
-# invariants (tests/test_models.py, tests/test_e2e.py convergence).
+@pytest.mark.slow
+def test_parity_har_transformer():
+    """HAR-family cross-framework parity, CI-enforced (VERDICT r4 #6).
+
+    At CI-affordable scale per-round accuracy is chaotic (swings up to
+    ~0.1 between adjacent rounds in both frameworks — round-5 calibration,
+    /tmp trajectory probes), so the assertion uses the MEAN of the last 3
+    rounds' accuracies, not the endpoint: the mean tracks the learning
+    level while absorbing the round-to-round noise.  Expected band from
+    measurement: both frameworks ~0.31-0.47 at this scale (chance 0.167).
+    Full-strength mid-range parity lives in HAR_PARITY.json
+    (scripts/har_parity.py: matched-round trajectories at 2 epochs)."""
+    cfg = Config(num_round=4, total_clients=3, mode="fedavg",
+                 model="TransformerClassifier", data_name="HAR",
+                 num_data_range=(128, 192), epochs=1, batch_size=32,
+                 train_size=512, test_size=256,
+                 log_path=".", checkpoint_dir=".")
+    _, hist = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    # run() appends retry entries (ok=False) and re-runs the round, so
+    # compare over the completed rounds only, like the siblings' hist[-1]
+    oks = [h for h in hist if h["ok"]]
+    assert len(oks) == 4
+    jax_mean = float(np.mean([h["accuracy"] for h in oks[-3:]]))
+
+    torch_out = torch_parity.run_har(
+        clients=3, rounds=4, epochs=1, batch_size=32,
+        num_data_range=(128, 192), train_size=512, test_size=256)
+    torch_mean = float(np.mean(torch_out["accuracy_trajectory"][-3:]))
+
+    chance = 1.0 / 6.0
+    assert jax_mean > chance + 0.05 and torch_mean > chance + 0.05
+    assert abs(jax_mean - torch_mean) < 0.15
 
 
 @pytest.mark.slow
