@@ -1,0 +1,13 @@
+#!/bin/bash
+# Chain the config-5 footprint compile behind a SPECIFIC round5_queue.sh
+# run (1-core box: never contend with the HAR timing measurement or the
+# suite).  Takes the queue PID so a stale QUEUE_DONE line in the
+# append-only, committed round5_queue.log can never release it early.
+#
+# Usage: bash scripts/after_queue_footprint.sh <queue_pid>
+set -u
+cd "$(dirname "$0")/.."
+QPID="${1:?usage: after_queue_footprint.sh <queue_pid>}"
+while kill -0 "$QPID" 2>/dev/null; do sleep 180; done
+nice -n 5 python -u scripts/config5_footprint.py > config5_footprint.log 2>&1
+echo "footprint rc=$? $(date -u +%FT%TZ)" >> round5_queue.log

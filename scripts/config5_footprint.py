@@ -1,0 +1,124 @@
+"""Measured memory footprint of the BASELINE config-5 program (VERDICT r4
+#3: the 1000-client v4-pod claim rested on docstring arithmetic — make it
+arithmetic over a MEASURED footprint).
+
+Compiles (does NOT execute) the config-5 round program — 16 stacked
+ResNet-18 CIFAR clients over the virtual 8-device mesh — and records:
+
+  * XLA's CompiledMemoryStats for the round step (argument/output/temp
+    bytes as the compiler scheduled them);
+  * the exact materialized byte count of one client's params and of the
+    fresh per-round Adam state (counted from real initialized arrays);
+  * the extrapolations that follow: bytes for 1000 stacked clients in
+    f32, vs one v5e chip (16 GB HBM) and a v4-8 pod slice (4 chips x
+    32 GB), i.e. the by-construction argument that config 5 at north-star
+    scale NEEDS the multi-chip mesh.
+
+CPU-backend caveat (recorded in the JSON): XLA-on-CPU may schedule temps
+differently from the TPU backend, so temp_size is a lower-bound sanity
+number, not a TPU HBM prediction; argument/output sizes are
+backend-independent array bytes.
+
+Usage: python -u scripts/config5_footprint.py [--out CONFIG5_FOOTPRINT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+V5E_HBM = 16 * 2**30
+V4_CHIP_HBM = 32 * 2**30
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", type=str,
+                    default=str(Path(__file__).resolve().parent.parent
+                                / "CONFIG5_FOOTPRINT.json"))
+    args = ap.parse_args()
+
+    import bench
+    import optax
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = bench.make_config(5)
+    t0 = time.time()
+    sim = Simulator(cfg, use_mesh=True)
+    assert sim.mesh is not None and sim.mesh.size == 8
+
+    state = sim.init_state()
+    rng, k_round = jax.random.split(state["rng"], 2)
+
+    # one client's footprint, counted from real arrays: params + the fresh
+    # per-round Adam state local training creates (training/local.py)
+    params = state["global_params"]
+    params_b = tree_bytes(params)
+    adam_b = tree_bytes(optax.adam(cfg.lr).init(params))
+
+    ex = (state["global_params"], state["prev_genuine"],
+          jnp.asarray(True), k_round, jnp.asarray(1))
+    compiled = sim.round_step.lower(*ex).compile()
+    ma = compiled.memory_analysis()
+    compile_s = time.time() - t0
+
+    n = cfg.total_clients
+    per_client = params_b + adam_b
+    ns_f32 = 1000 * per_client
+    out = {
+        "config": {"clients": n, "model": cfg.model, "mesh_devices": 8,
+                   "batch_size": cfg.batch_size,
+                   "num_data_range": list(cfg.num_data_range)},
+        "compile_s": round(compile_s, 1),
+        "xla_memory_stats_bytes": {
+            "argument": int(ma.argument_size_in_bytes),
+            "output": int(ma.output_size_in_bytes),
+            "temp": int(ma.temp_size_in_bytes),
+            "alias": int(ma.alias_size_in_bytes),
+        },
+        "measured_per_client_bytes": {
+            "resnet18_params_f32": params_b,
+            "adam_state_f32": adam_b,
+            "params_plus_adam": per_client,
+        },
+        "extrapolation": {
+            "stacked_16_clients_gb": round(16 * per_client / 2**30, 2),
+            "stacked_1000_clients_f32_gb": round(ns_f32 / 2**30, 1),
+            "v5e_hbm_gb": 16,
+            "v4_8_pod_hbm_gb": 128,
+            "fits_one_v5e_chip_1000c": bool(ns_f32 < V5E_HBM),
+            "min_v4_chips_params_opt_only": int(np.ceil(ns_f32 / V4_CHIP_HBM)),
+        },
+        "caveat": "CPU-backend XLA stats; temp scheduling differs on TPU — "
+                  "argument/output and the per-client array bytes are "
+                  "backend-independent",
+    }
+    Path(args.out).write_text(json.dumps(out, indent=1))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,7 +72,11 @@ def main() -> None:
     _, hist = Simulator(cfg).run_fast(save_checkpoints=False, verbose=True,
                                       chunk_size=1)
     jax_s = time.time() - t0
-    jax_traj = [float(h.get("accuracy", float("nan"))) for h in hist]
+    # completed rounds only: run_fast appends ok=False retry entries and
+    # re-runs the round, which would misalign the matched-round comparison
+    # against torch's strictly-per-round trajectory
+    jax_traj = [float(h.get("accuracy", float("nan")))
+                for h in hist if h.get("ok")]
 
     t0 = time.time()
     torch_out = torch_parity.run_har(

@@ -114,10 +114,14 @@ def test_parity_har_transformer():
     ~0.1 between adjacent rounds in both frameworks — round-5 calibration,
     /tmp trajectory probes), so the assertion uses the MEAN of the last 3
     rounds' accuracies, not the endpoint: the mean tracks the learning
-    level while absorbing the round-to-round noise.  Expected band from
-    measurement: both frameworks ~0.31-0.47 at this scale (chance 0.167).
-    Full-strength mid-range parity lives in HAR_PARITY.json
-    (scripts/har_parity.py: matched-round trajectories at 2 epochs)."""
+    level while absorbing the round-to-round noise.  Two distinct bands:
+    the accuracy LEVEL varies ~0.31-0.47 across seeds/configs at this
+    scale (chance 0.167), but the cross-framework GAP on the same arrays
+    and matched rounds measured 0.004 (endpoint, round-4) — the 0.15
+    tolerance bounds the gap, with ~30x slack for per-round noise on the
+    3-round mean.  Full-strength mid-range parity lives in
+    HAR_PARITY.json (scripts/har_parity.py: matched-round trajectories
+    at 2 epochs)."""
     cfg = Config(num_round=4, total_clients=3, mode="fedavg",
                  model="TransformerClassifier", data_name="HAR",
                  num_data_range=(128, 192), epochs=1, batch_size=32,
