@@ -104,15 +104,16 @@ def tpu_init_watchdog(metric: str, seconds: float = 600.0):
     def _boom():
         if not done.is_set():
             # a dead tunnel must not leave the record contentless: point at
-            # the committed same-host CPU evidence (BASELINE.md) with ONE
-            # headline number per artifact — inlining the full files would
-            # grow the one-line JSON contract without bound and duplicate
-            # data already committed in the repo (ADVICE r4 #3)
+            # the committed same-host CPU evidence (BASELINE.md) with just
+            # the few headline numbers per artifact — inlining the full
+            # files would grow the one-line JSON contract without bound and
+            # duplicate data already committed in the repo (ADVICE r4 #3)
             evidence = {}
             from pathlib import Path
             headline_keys = ("rounds_per_sec", "rounds_per_sec_steady",
                              "rounds_per_sec_incl_compile", "final_roc_auc",
-                             "jax_final_accuracy", "torch_final_accuracy")
+                             "jax_final_accuracy", "torch_final_accuracy",
+                             "midrange_abs_diff")
             for p in ("parity_full_torch.json", "FULL_PARITY_JAX.json",
                       "FULL_PARITY_JAX_STEADY.json", "NORTHSTAR_CPU.json",
                       "HAR_PARITY.json"):
