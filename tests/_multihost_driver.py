@@ -6,7 +6,11 @@ the topology being replaced: broker + one process per machine), builds a
 client mesh spanning BOTH processes' virtual CPU devices, and runs one
 full federated round SPMD.  Run by tests/test_multihost.py.
 
-Usage: python _multihost_driver.py <coordinator> <num_processes> <pid>
+Usage: python _multihost_driver.py <coordinator> <num_processes> <pid> [mode]
+
+``mode`` defaults to "fedavg" (round + checkpoint resume + fused scan);
+"hyper" runs one pFedHN round instead — the sequential per-client
+hnet update and pooled hyper validation over the DCN-spanning mesh.
 """
 
 import os
@@ -24,8 +28,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def _base_config_kwargs() -> dict:
+    """Geometry shared by every driver mode — one definition so a mesh-size
+    tweak cannot silently diverge the fedavg and hyper paths.  CNNModel on
+    purpose: these tests exercise DCN plumbing (mesh span, collectives,
+    checkpoint gather/broadcast), not model capacity — the Transformer's
+    compile time would sink the fast tier the fedavg test lives in."""
+    tmp = os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost")
+    return dict(
+        num_round=1,
+        total_clients=16,
+        model="CNNModel",
+        data_name="ICU",
+        num_data_range=(24, 32),
+        epochs=1,
+        batch_size=16,
+        train_size=128,
+        test_size=64,
+        validation=True,
+        log_path=tmp,
+        checkpoint_dir=tmp,
+    )
+
+
 def main() -> None:
     coordinator, num_processes, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "fedavg"
     from attackfl_tpu.parallel.mesh import distributed_init, make_client_mesh
 
     distributed_init(coordinator, num_processes, pid)
@@ -36,25 +64,14 @@ def main() -> None:
     from attackfl_tpu.training.engine import Simulator
 
     mesh = make_client_mesh()
-    # CNNModel on purpose: this test exercises DCN plumbing (mesh span,
-    # collectives, checkpoint gather/broadcast), not model capacity — the
-    # Transformer's compile time would sink the fast tier it lives in.
+    if mode == "hyper":
+        _run_hyper(pid, mesh)
+        return
     cfg = Config(
-        num_round=1,
-        total_clients=16,
         mode="fedavg",
-        model="CNNModel",
-        data_name="ICU",
-        num_data_range=(24, 32),
-        epochs=1,
-        batch_size=16,
-        train_size=128,
-        test_size=64,
-        validation=True,
         genuine_rate=0.5,
         attacks=(AttackSpec(mode="LIE", num_clients=4, attack_round=1),),
-        log_path=os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost"),
-        checkpoint_dir=os.environ.get("MULTIHOST_TMP", "/tmp/attackfl_multihost"),
+        **_base_config_kwargs(),
     )
     sim = Simulator(cfg, mesh=mesh)
     assert sim.multiprocess, "mesh should span both processes"
@@ -84,6 +101,25 @@ def main() -> None:
     print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f} "
           f"scan_ok={scan_ok} scan_auc={scan_auc:.4f} "
           f"resumed_rounds={resumed_rounds}", flush=True)
+
+
+def _run_hyper(pid: int, mesh) -> None:
+    """One pFedHN round SPMD over the DCN mesh: per-client generated
+    weights, vmapped local training, the order-faithful sequential
+    hnet vjp+Adam scan, pooled hyper validation (reference flow:
+    server.py:637-680 + Validation.test_hyper) — all as collectives over
+    the two-process device span."""
+    from attackfl_tpu.config import Config
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = Config(mode="hyper", **_base_config_kwargs())
+    sim = Simulator(cfg, mesh=mesh)
+    assert sim.multiprocess, "mesh should span both processes"
+    state, history = sim.run(save_checkpoints=False, verbose=False)
+    ok_rounds = sum(1 for h in history if h["ok"])
+    auc = history[-1].get("roc_auc", float("nan"))
+    print(f"MULTIHOST_HYPER_OK pid={pid} ok_rounds={ok_rounds} "
+          f"roc_auc={auc:.4f}", flush=True)
 
 
 if __name__ == "__main__":

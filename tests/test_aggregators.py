@@ -255,6 +255,7 @@ def test_masked_all_ones_identical_to_static():
         (agg.trimmed_mean(t, 0.2, ones), agg.trimmed_mean(t, 0.2)),
         (agg.krum(t, 0, ones), agg.krum(t, 0)),
         (agg.shieldfl(t, mask=ones), agg.shieldfl(t)),
+        (agg.byzantine_tolerance(t, 0.9, ones), agg.byzantine_tolerance(t, 0.9)),
     ):
         for k in t:
             np.testing.assert_allclose(np.asarray(masked[k]),

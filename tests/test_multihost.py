@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 DRIVER = os.path.join(os.path.dirname(__file__), "_multihost_driver.py")
 
 
@@ -22,16 +24,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_round(tmp_path):
-    """Fast-tier on purpose (VERDICT r3 weak #5): the DCN path is the most
-    fragile subsystem and must run in the tier developers actually use —
-    it is a 2-process, 1-round CPU test."""
+def _run_driver(tmp_path, marker: str, timeout: int, *extra_args: str):
+    """Spawn the 2-process driver, assert both exit green with ``marker``
+    and one ok round, and return the marker lines for metric asserts."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {**os.environ, "MULTIHOST_TMP": str(tmp_path)}
     env.pop("JAX_PLATFORMS", None)  # driver pins cpu itself
     procs = [
         subprocess.Popen(
-            [sys.executable, DRIVER, coordinator, "2", str(pid)],
+            [sys.executable, DRIVER, coordinator, "2", str(pid), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
         for pid in range(2)
@@ -39,7 +40,7 @@ def test_two_process_round(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=600)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -47,12 +48,30 @@ def test_two_process_round(tmp_path):
                 p.kill()
     for rc, out, err in outs:
         assert rc == 0, f"process failed (rc={rc}):\n{out}\n{err[-3000:]}"
-        assert "MULTIHOST_OK" in out, out
+        assert marker in out, out
         assert "ok_rounds=1" in out, out
-        assert "scan_ok=2" in out, out  # fused scan path, 2 rounds, SPMD
+    return [next(l for l in out.splitlines() if marker in l)
+            for _, out, _ in outs]
+
+
+def test_two_process_round(tmp_path):
+    """Fast-tier on purpose (VERDICT r3 weak #5): the DCN path is the most
+    fragile subsystem and must run in the tier developers actually use —
+    it is a 2-process, 1-round CPU test."""
+    lines = _run_driver(tmp_path, "MULTIHOST_OK", 600)
+    for line in lines:
+        assert "scan_ok=2" in line, line  # fused scan path, 2 rounds, SPMD
     # both processes ran the same SPMD program: identical metrics
-    lines = [next(l for l in out.splitlines() if "MULTIHOST_OK" in l)
-             for _, out, _ in outs]
     auc0 = lines[0].split("roc_auc=")[1]
     auc1 = lines[1].split("roc_auc=")[1]
     assert auc0 == auc1, (auc0, auc1)
+
+
+@pytest.mark.slow
+def test_two_process_hyper_round(tmp_path):
+    """pFedHN over DCN: the sequential hnet update and pooled hyper
+    validation must run SPMD over a mesh spanning both processes (the
+    fedavg smoke above covers the plain-round plumbing; hyper exercises
+    per-client generated weights + the O(C) vjp+Adam scan)."""
+    lines = _run_driver(tmp_path, "MULTIHOST_HYPER_OK", 900, "hyper")
+    assert lines[0].split("roc_auc=")[1] == lines[1].split("roc_auc=")[1]
