@@ -27,7 +27,7 @@ BASE = dict(
 )
 
 
-@pytest.mark.parametrize("mode", ["fedavg", "hyper"])
+@pytest.mark.parametrize("mode", ["fedavg", "hyper", "byzantine"])
 @pytest.mark.slow
 def test_fused_matches_per_round(mode, tmp_path):
     cfg = Config(mode=mode, log_path=str(tmp_path), **BASE)
