@@ -1,0 +1,6 @@
+"""``python -m attackfl_tpu`` — the ``attackfl-tpu`` umbrella CLI."""
+
+from attackfl_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,6 +10,14 @@ invocation writes a registration (client id + attack flags) into
 then runs the whole federation on the TPU.  ``server.py --no-wait`` skips
 the rendezvous and reads attackers from the config's ``attack-clients``
 section instead.
+
+``main`` is the ``attackfl-tpu`` umbrella entry point
+(``python -m attackfl_tpu`` / the repo-root ``attackfl-tpu`` script):
+
+* ``attackfl-tpu run [--config ...] [--rounds N]`` — run the federation
+  with attackers from the config (no rendezvous), telemetry on by default;
+* ``attackfl-tpu server`` / ``attackfl-tpu client`` — the rendezvous pair;
+* ``attackfl-tpu metrics <dir>`` — summarize a run's ``events.jsonl``.
 """
 
 from __future__ import annotations
@@ -22,7 +30,7 @@ import time
 import uuid
 
 from attackfl_tpu.config import AttackSpec, Config, load_config
-from attackfl_tpu.utils.logging import print_with_color
+from attackfl_tpu.telemetry import print_with_color
 
 REG_DIR = ".registrations"
 
@@ -170,3 +178,54 @@ def server_main(argv=None) -> None:
     state, history = sim.run(num_rounds=args.rounds)
     ok_rounds = sum(1 for h in history if h["ok"])
     print_with_color(f"Finished: {ok_rounds} successful rounds.", "green")
+    if sim.telemetry.enabled:
+        print_with_color(
+            f"Telemetry: {sim.telemetry.events.path} "
+            f"(summarize with `attackfl-tpu metrics`), trace: "
+            f"{sim.telemetry.tracer.path} (open in https://ui.perfetto.dev)",
+            "cyan")
+
+
+def run_main(argv=None) -> None:
+    """``attackfl-tpu run``: the no-rendezvous launcher (attackers come
+    from the config's ``attack-clients`` section)."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    server_main(["--no-wait", *args])
+
+
+def metrics_main(argv=None) -> int:
+    """``attackfl-tpu metrics``: summarize a run's events.jsonl."""
+    from attackfl_tpu.telemetry.summary import main as summary_main
+
+    return summary_main(list(sys.argv[1:] if argv is None else argv))
+
+
+_SUBCOMMANDS = {
+    "run": run_main,
+    "server": server_main,
+    "client": client_main,
+    "metrics": metrics_main,
+}
+
+_USAGE = """usage: attackfl-tpu <command> [args]
+
+commands:
+  run      run the federation in-process (attackers from config; telemetry on)
+  server   rendezvous server (waits for `client` registrations)
+  client   register one client (reference client.py parity)
+  metrics  summarize a run directory's events.jsonl (p50/p95, rounds/s)
+"""
+
+
+def main(argv=None) -> int:
+    """Umbrella ``attackfl-tpu`` entry point (also ``python -m attackfl_tpu``)."""
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(_USAGE, end="")
+        return 0 if args else 2
+    command = _SUBCOMMANDS.get(args[0])
+    if command is None:
+        print(f"unknown command {args[0]!r}\n{_USAGE}", end="", file=sys.stderr)
+        return 2
+    result = command(args[1:])
+    return int(result) if isinstance(result, int) else 0

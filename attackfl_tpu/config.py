@@ -57,6 +57,31 @@ class HyperDetectionConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Observability knobs (attackfl_tpu/telemetry): structured JSONL
+    events + Chrome-trace spans + counters.
+
+    ``enabled`` gates ALL file output (events.jsonl / trace.json); off, the
+    engine uses null objects and pays no per-round I/O.  ``sample_every``
+    thins per-round event records for very long runs (failed rounds and the
+    compile round are always recorded).  Empty paths default to
+    ``<log_path>/events.jsonl`` and ``<log_path>/trace.json``; the
+    ``ATTACKFL_TELEMETRY_DIR`` env var (test harness) overrides the base
+    directory.
+    """
+
+    enabled: bool = True
+    sample_every: int = 1
+    events_path: str = ""
+    trace_path: str = ""
+
+    def __post_init__(self):
+        if self.sample_every < 1:
+            raise ValueError(
+                f"telemetry.sample_every must be >= 1, got {self.sample_every}")
+
+
+@dataclass(frozen=True)
 class AttackSpec:
     """One group of attacker clients.
 
@@ -171,6 +196,7 @@ class Config:
 
     # --- infra ---
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log_path: str = "."
     checkpoint_dir: str = "."
     # Krum's assumed-malicious count f.  The reference computes
@@ -317,6 +343,7 @@ def config_from_dict(raw: dict) -> Config:
     dist = _get(server, "data-distribution", {})
     ndr = _get(dist, "num-data-range", [12000, 15000])
     mesh = _get(raw, "tpu", {})
+    tele = _get(raw, "telemetry", {})
 
     attacks = []
     for a in _get(raw, "attack-clients", []) or []:
@@ -372,6 +399,12 @@ def config_from_dict(raw: dict) -> Config:
             num_devices=int(_get(mesh, "num-devices", 0)),
             axis_name=str(_get(mesh, "axis-name", "clients")),
             compute_dtype=str(_get(mesh, "compute-dtype", "float32")),
+        ),
+        telemetry=TelemetryConfig(
+            enabled=bool(_get(tele, "enabled", True)),
+            sample_every=int(_get(tele, "sample-every", 1)),
+            events_path=str(_get(tele, "events-path", "")),
+            trace_path=str(_get(tele, "trace-path", "")),
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),

@@ -145,11 +145,15 @@ class Validation:
     surface (``test``/``test_hyper``, src/Validation.py:19-214), with jitted
     evaluators underneath."""
 
-    def __init__(self, model, data_name: str, test_data: Batch, logger=None):
+    def __init__(self, model, data_name: str, test_data: Batch, logger=None,
+                 telemetry=None):
         if data_name not in _EVALUATORS:
             raise ValueError(f"Data name '{data_name}' is not valid.")
         self.data_name = data_name
         self.logger = logger
+        # telemetry is host-side only: the raw eval_fns below stay pure so
+        # the fused round-scan can still inline them into its XLA program
+        self.telemetry = telemetry
         self.test_data = {k: jnp.asarray(v) for k, v in test_data.items()}
         # raw (unjitted) evaluators are exposed so the fused round-scan can
         # inline validation into its own XLA program
@@ -165,6 +169,17 @@ class Validation:
             self.eval_hyper_fn = None
             self._eval_hyper = None
 
+    def _record(self, ok: bool, metrics: dict[str, float]) -> None:
+        """Failed validations are recorded as events (a failed gate retries
+        the whole round — exactly the diagnosis-by-grep gap the telemetry
+        layer closes); successes ride the round record instead."""
+        if self.telemetry is None or not self.telemetry.enabled:
+            return
+        if not ok:
+            self.telemetry.counters.inc("validation_failures")
+            self.telemetry.events.emit(
+                "validation", ok=False, data_name=self.data_name, **metrics)
+
     def test(self, params: Any) -> tuple[bool, dict[str, float]]:
         out = {k: np.asarray(v) for k, v in self._eval(params).items()}
         ok = bool(out.pop("ok"))
@@ -173,6 +188,7 @@ class Validation:
             self.logger.log_info(
                 " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
             )
+        self._record(ok, metrics)
         return ok, metrics
 
     def test_hyper(self, stacked_params: Any) -> tuple[bool, dict[str, float]]:
@@ -180,4 +196,6 @@ class Validation:
             raise ValueError(f"Not found hyper test function for data name {self.data_name}")
         out = {k: np.asarray(v) for k, v in self._eval_hyper(stacked_params).items()}
         ok = bool(out.pop("ok"))
-        return ok, {k: float(v) for k, v in out.items()}
+        metrics = {k: float(v) for k, v in out.items()}
+        self._record(ok, metrics)
+        return ok, metrics

@@ -216,6 +216,13 @@ def byzantine_tolerance(stacked: Any, threshold: float = 0.9,
         jnp.linalg.norm(flat, axis=1) * jnp.linalg.norm(anchor) + 1e-12)
     keep = (cos >= threshold).astype(flat.dtype) * maskf
     keep = jnp.where(jnp.sum(keep) > 0, keep, maskf)
+    # degenerate all-zero participation mask (every client dropped): the
+    # maskf fallback is itself all-zero and tree_weighted_mean would
+    # divide by sum(weights)=0 → NaN params (ADVICE.md finding 1).  Fall
+    # back to an unweighted mean; callers fail such rounds upstream, but
+    # the fused scan body evaluates the aggregate unconditionally and must
+    # not see NaNs it didn't create.
+    keep = jnp.where(jnp.sum(maskf) > 0, keep, jnp.ones_like(maskf))
     return pt.tree_weighted_mean(stacked, keep)
 
 

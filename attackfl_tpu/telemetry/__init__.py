@@ -1,0 +1,52 @@
+"""First-class observability for the federation engine.
+
+Pieces (ISSUE 1 tentpole):
+
+* :class:`EventLog` — structured JSONL records (``events.jsonl``): run
+  header, per-round phase durations + metrics + attack/defense decisions,
+  compile/chunk records, retry/rollback/checkpoint lifecycle, counters.
+* :class:`Tracer` — nested host-side spans serialized in Chrome
+  trace-event format (``trace.json``; open in https://ui.perfetto.dev).
+* :class:`Counters` — monotonic health counters (rounds retried, NaN
+  clients, anomalies removed, checkpoint writes, program-cache hits).
+* :mod:`~attackfl_tpu.telemetry.summary` — the ``attackfl-tpu metrics``
+  CLI turning ``events.jsonl`` into per-phase p50/p95 and rounds/s
+  (steady vs incl-compile) tables.
+
+Everything records host-side values only — no callbacks ever enter traced
+code, so telemetry is zero-cost inside jitted programs and a null-object
+no-op when ``telemetry.enabled: false``.
+
+``Logger``/``RoundTimer``/``print_with_color`` live here now;
+``attackfl_tpu.utils.logging`` remains as a compatibility shim.
+"""
+
+from attackfl_tpu.telemetry.console import Logger, print_with_color  # noqa: F401
+from attackfl_tpu.telemetry.core import Telemetry  # noqa: F401
+from attackfl_tpu.telemetry.counters import Counters  # noqa: F401
+from attackfl_tpu.telemetry.events import (  # noqa: F401
+    SCHEMA_VERSION,
+    EventLog,
+    NullEventLog,
+    metric_line,
+    validate_event,
+)
+from attackfl_tpu.telemetry.timing import RoundTimer  # noqa: F401
+from attackfl_tpu.telemetry.trace import NullTracer, Tracer  # noqa: F401
+from attackfl_tpu.telemetry.xla import memory_analysis_bytes  # noqa: F401
+
+__all__ = [
+    "Counters",
+    "EventLog",
+    "Logger",
+    "NullEventLog",
+    "NullTracer",
+    "RoundTimer",
+    "SCHEMA_VERSION",
+    "Telemetry",
+    "Tracer",
+    "memory_analysis_bytes",
+    "metric_line",
+    "print_with_color",
+    "validate_event",
+]

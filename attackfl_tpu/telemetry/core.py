@@ -1,0 +1,61 @@
+"""The ``Telemetry`` facade: one object bundling the event log, tracer
+and counters, built from a :class:`~attackfl_tpu.config.Config`.
+
+Output routing: ``ATTACKFL_TELEMETRY_DIR`` (set by the test harness to
+keep artifacts out of the repo) overrides the config's ``log_path`` as the
+base directory; explicit ``telemetry.events_path`` / ``telemetry.trace_path``
+override the per-file defaults ``<base>/events.jsonl`` and
+``<base>/trace.json``.
+
+With ``telemetry.enabled: false`` the facade is inert: no files are
+opened, the event log and tracer are null objects, and only the in-memory
+counters stay live (a dict increment — unmeasurable per round).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from attackfl_tpu.telemetry.counters import Counters
+from attackfl_tpu.telemetry.events import EventLog, NullEventLog
+from attackfl_tpu.telemetry.trace import NullTracer, Tracer
+
+ENV_DIR = "ATTACKFL_TELEMETRY_DIR"
+
+
+class Telemetry:
+    def __init__(self, events, tracer, counters: Counters, enabled: bool):
+        self.events = events
+        self.tracer = tracer
+        self.counters = counters
+        self.enabled = enabled
+
+    @classmethod
+    def disabled(cls) -> "Telemetry":
+        return cls(NullEventLog(), NullTracer(), Counters(), False)
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> "Telemetry":
+        tcfg = getattr(cfg, "telemetry", None)
+        if tcfg is None or not getattr(tcfg, "enabled", False):
+            return cls.disabled()
+        base = os.environ.get(ENV_DIR) or getattr(cfg, "log_path", ".") or "."
+        events_path = tcfg.events_path or os.path.join(base, "events.jsonl")
+        trace_path = tcfg.trace_path or os.path.join(base, "trace.json")
+        return cls(
+            EventLog(events_path, sample_every=tcfg.sample_every),
+            Tracer(trace_path),
+            Counters(),
+            True,
+        )
+
+    def flush(self) -> None:
+        """Persist everything buffered (the trace is memory-buffered; the
+        event log is line-buffered already)."""
+        self.tracer.write()
+        self.events.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.events.close()

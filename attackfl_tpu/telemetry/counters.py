@@ -1,0 +1,29 @@
+"""Monotonic counter registry for run-level health accounting.
+
+Counts the events the round loop otherwise only prints: rounds retried,
+NaN training rounds / NaN clients detected, anomalies removed by defenses,
+validation failures, checkpoint writes, and compiled-round-program cache
+hits/misses.  A plain dict increment — cheap enough to stay live even when
+file telemetry is disabled, so the final snapshot is always available
+in-process (``Simulator.telemetry.counters``)."""
+
+from __future__ import annotations
+
+
+class Counters:
+    def __init__(self):
+        self._counts: dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        value = self._counts.get(name, 0) + int(n)
+        self._counts[name] = value
+        return value
+
+    def get(self, name: str) -> int:
+        return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        return dict(sorted(self._counts.items()))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Counters({self._counts!r})"

@@ -1,0 +1,200 @@
+"""Structured JSONL event log: the machine-readable run record.
+
+Every run appends one JSON object per line to ``events.jsonl``: a
+``run_header`` (config + backend + device info), one ``round`` record per
+executed round (phase durations, losses, quality metrics, attack/defense
+decisions), ``compile``/``chunk`` records from the fused scan path,
+``retry``/``rollback``/``checkpoint`` lifecycle events, and a final
+``counters`` + ``run_end`` pair.  The schema is versioned and validated by
+``validate_event`` (used by tests and ``scripts/check_event_schema.py``),
+and ``attackfl_tpu.telemetry.summary`` turns the file back into the
+per-phase p50/p95 and rounds/s numbers previously hand-extracted into
+bench artifacts like ``FULL_PARITY_JAX_STEADY.json``.
+
+Recording is strictly host-side: only values already materialized per
+round (metrics dicts, timer durations) are written — never callbacks
+inside traced/jitted code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any
+
+SCHEMA_VERSION = 1
+
+# Required fields per event kind (beyond the common envelope).  Extra
+# fields are always allowed; these are the floor the tooling relies on.
+# NOTE: bool is checked before int (bool subclasses int in Python).
+_NUM = (int, float)
+REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
+    "run_header": {"run_id": str, "backend": str, "num_devices": int,
+                   "mode": str, "model": str, "data_name": str},
+    "round": {"round": int, "broadcast": int, "ok": bool},
+    "chunk": {"chunk_len": int, "seconds": _NUM, "includes_compile": bool},
+    "compile": {"program": str, "seconds": _NUM},
+    "retry": {"round": int, "retries": int},
+    "rollback": {"removed": list, "broadcast": int},
+    "checkpoint": {"path": str},
+    "validation": {"ok": bool},
+    "counters": {"counters": dict},
+    "run_end": {"rounds": int, "ok_rounds": int, "seconds": _NUM},
+    # bench.py's one-line metric contract, emitted through the same schema
+    "metric": {"metric": str, "value": _NUM, "unit": str},
+}
+
+_COMMON_FIELDS: dict[str, Any] = {"schema": int, "kind": str, "ts": _NUM}
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion of numpy/jax scalars and arrays to plain
+    Python so every record round-trips through ``json``."""
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, (str, bool, int, float)) or value is None:
+        return value
+    item = getattr(value, "item", None)
+    if item is not None and getattr(value, "ndim", None) in (0, None):
+        try:
+            return item()
+        except Exception:  # noqa: BLE001 — fall through to str
+            pass
+    tolist = getattr(value, "tolist", None)
+    if tolist is not None:
+        try:
+            return tolist()
+        except Exception:  # noqa: BLE001
+            pass
+    return str(value)
+
+
+def validate_event(record: Any) -> list[str]:
+    """Return a list of schema violations for one decoded event (empty =
+    valid).  Checks the common envelope, the kind, and the kind's required
+    fields/types; extra fields are allowed by design."""
+    if not isinstance(record, dict):
+        return [f"event is not an object: {type(record).__name__}"]
+    errors: list[str] = []
+    for name, typ in _COMMON_FIELDS.items():
+        if name not in record:
+            errors.append(f"missing common field '{name}'")
+        elif typ is int and isinstance(record[name], bool):
+            errors.append(f"field '{name}' must be int, got bool")
+        elif not isinstance(record[name], typ):
+            errors.append(
+                f"field '{name}' has type {type(record[name]).__name__}")
+    kind = record.get("kind")
+    if isinstance(kind, str):
+        required = REQUIRED_FIELDS.get(kind)
+        if required is None:
+            errors.append(f"unknown event kind '{kind}'")
+        else:
+            for name, typ in required.items():
+                if name not in record:
+                    errors.append(f"[{kind}] missing field '{name}'")
+                    continue
+                value = record[name]
+                if typ is bool:
+                    if not isinstance(value, bool):
+                        errors.append(f"[{kind}] '{name}' must be bool")
+                elif typ is int:
+                    if isinstance(value, bool) or not isinstance(value, int):
+                        errors.append(f"[{kind}] '{name}' must be int")
+                elif typ == _NUM:
+                    if isinstance(value, bool) or not isinstance(value, _NUM):
+                        errors.append(f"[{kind}] '{name}' must be a number")
+                elif not isinstance(value, typ):
+                    errors.append(
+                        f"[{kind}] '{name}' must be {typ.__name__}, got "
+                        f"{type(value).__name__}")
+    schema = record.get("schema")
+    if isinstance(schema, int) and schema > SCHEMA_VERSION:
+        errors.append(f"schema version {schema} is newer than "
+                      f"{SCHEMA_VERSION}; update the tooling")
+    return errors
+
+
+def metric_line(metric: str, value: float, unit: str = "rounds/s",
+                **extra: Any) -> dict[str, Any]:
+    """Build bench.py's one-line JSON metric record in the telemetry
+    schema.  Key order keeps the historical contract (metric/value/unit
+    first) with the schema envelope appended."""
+    record: dict[str, Any] = {"metric": metric, "value": _jsonable(value),
+                              "unit": unit}
+    record.update({k: _jsonable(v) for k, v in extra.items()})
+    record.setdefault("schema", SCHEMA_VERSION)
+    record.setdefault("kind", "metric")
+    record.setdefault("ts", round(time.time(), 6))
+    return record
+
+
+class EventLog:
+    """Append-only JSONL writer for one run (line-buffered, so partial
+    runs — the round-5 wedge scenario — still leave a usable record)."""
+
+    enabled = True
+
+    def __init__(self, path: str, sample_every: int = 1,
+                 run_id: str | None = None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.sample_every = max(int(sample_every), 1)
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self._fh = open(path, "a", buffering=1)
+
+    def emit(self, kind: str, **fields: Any) -> dict[str, Any]:
+        record: dict[str, Any] = {
+            "schema": SCHEMA_VERSION,
+            "kind": kind,
+            "ts": round(time.time(), 6),
+            "run_id": self.run_id,
+        }
+        for key, value in fields.items():
+            record[key] = _jsonable(value)
+        self._fh.write(json.dumps(record) + "\n")
+        return record
+
+    def round_event(self, metrics: dict[str, Any]) -> None:
+        """Record one round, honoring ``sample_every`` (failed rounds and
+        round 1 — the compile round — are always recorded)."""
+        rnd = int(metrics.get("round", 0))
+        ok = bool(metrics.get("ok", True))
+        if (self.sample_every > 1 and ok and rnd != 1
+                and rnd % self.sample_every != 0):
+            return
+        self.emit("round", **metrics)
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except Exception:  # noqa: BLE001 — double-close etc. is harmless
+            pass
+
+
+class NullEventLog:
+    """Disabled-telemetry stand-in: no file, every method a no-op."""
+
+    enabled = False
+    path = None
+    run_id = "disabled"
+    sample_every = 1
+
+    def emit(self, kind: str, **fields: Any) -> dict[str, Any]:
+        return {}
+
+    def round_event(self, metrics: dict[str, Any]) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,251 @@
+"""The ``metrics`` CLI: turn ``events.jsonl`` back into a run summary.
+
+``attackfl-tpu metrics <dir-or-file>`` prints, for the last run recorded
+in the file (or a specific ``--run-id``): per-phase p50/p95/mean,
+rounds/s both steady-state and including compile (the same split
+previously hand-extracted into ``FULL_PARITY_JAX_STEADY.json``), the
+final quality metric, and the counters snapshot.
+
+Deliberately jax-free: it reads JSON and does percentile arithmetic, so it
+runs instantly on any box holding a bench artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+FINAL_METRIC_KEYS = ("roc_auc", "accuracy", "nll", "train_loss")
+
+
+def load_events(path: str) -> list[dict[str, Any]]:
+    """Read events from a file, or from ``<path>/events.jsonl`` when given
+    a directory.  Malformed lines are skipped (a wedged run can die
+    mid-write) but counted into the '_skipped' sentinel of the result."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    events: list[dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(record, dict):
+                events.append(record)
+    return events
+
+
+def split_runs(events: list[dict[str, Any]]) -> list[list[dict[str, Any]]]:
+    """Group an appended multi-run file into per-run segments by run_id
+    (falling back to run_header boundaries for id-less records)."""
+    runs: list[list[dict[str, Any]]] = []
+    index: dict[str, int] = {}
+    for event in events:
+        run_id = event.get("run_id")
+        if run_id is None:
+            if not runs or event.get("kind") == "run_header":
+                runs.append([])
+            runs[-1].append(event)
+            continue
+        if run_id not in index:
+            index[run_id] = len(runs)
+            runs.append([])
+        runs[index[run_id]].append(event)
+    return runs
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy's default), dependency-free."""
+    if not values:
+        return float("nan")
+    ordered = sorted(values)
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = (len(ordered) - 1) * (q / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
+
+
+def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
+    """Aggregate one run's events into the summary dict the CLI renders."""
+    header = next((e for e in events if e.get("kind") == "run_header"), None)
+    rounds = [e for e in events if e.get("kind") == "round"]
+    chunks = [e for e in events if e.get("kind") == "chunk"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    retries = [e for e in events if e.get("kind") == "retry"]
+    counters = next((e["counters"] for e in reversed(events)
+                     if e.get("kind") == "counters"), None)
+    run_end = next((e for e in reversed(events)
+                    if e.get("kind") == "run_end"), None)
+
+    phases: dict[str, list[float]] = {}
+    for record in rounds:
+        for name, dur in (record.get("phases") or {}).items():
+            if isinstance(dur, (int, float)) and not isinstance(dur, bool):
+                phases.setdefault(name, []).append(float(dur))
+    per_phase = {
+        name: {
+            "p50_s": round(percentile(vals, 50), 6),
+            "p95_s": round(percentile(vals, 95), 6),
+            "mean_s": round(sum(vals) / len(vals), 6),
+            "count": len(vals),
+        }
+        for name, vals in phases.items()
+    }
+
+    ok_rounds = sum(1 for r in rounds if r.get("ok"))
+    rates: dict[str, Any] = {}
+    if chunks:
+        # fused path: per-chunk wall is the genuine measurement; the first
+        # dispatch of a chunk length includes its compile
+        total_rounds = sum(int(c["chunk_len"]) for c in chunks)
+        total_s = sum(float(c["seconds"]) for c in chunks)
+        steady = [c for c in chunks if not c.get("includes_compile")]
+        if total_s > 0:
+            rates["rounds_per_sec_incl_compile"] = round(total_rounds / total_s, 4)
+        if steady:
+            steady_rounds = sum(int(c["chunk_len"]) for c in steady)
+            steady_s = sum(float(c["seconds"]) for c in steady)
+            if steady_s > 0:
+                rates["rounds_per_sec_steady"] = round(steady_rounds / steady_s, 4)
+                rates["seconds_per_round_steady"] = round(steady_s / steady_rounds, 4)
+    else:
+        timed = [r for r in rounds
+                 if isinstance(r.get("seconds"), (int, float))]
+        total_s = sum(float(r["seconds"]) for r in timed)
+        if timed and total_s > 0:
+            rates["rounds_per_sec_incl_compile"] = round(len(timed) / total_s, 4)
+        if len(timed) > 1:
+            # round 1's wall time includes every first-call jit compile
+            steady_s = sum(float(r["seconds"]) for r in timed[1:])
+            if steady_s > 0:
+                rates["rounds_per_sec_steady"] = round(
+                    (len(timed) - 1) / steady_s, 4)
+                rates["seconds_per_round_steady"] = round(
+                    steady_s / (len(timed) - 1), 4)
+
+    final: dict[str, float] = {}
+    for record in reversed(rounds):
+        if not record.get("ok"):
+            continue
+        for key in FINAL_METRIC_KEYS:
+            value = record.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                final[key] = value
+        if final:
+            break
+
+    return {
+        "run_id": (header or {}).get("run_id"),
+        "header": {k: (header or {}).get(k) for k in
+                   ("backend", "num_devices", "mode", "model", "data_name",
+                    "total_clients")} if header else None,
+        "rounds_attempted": len(rounds),
+        "rounds_ok": ok_rounds,
+        "retries": len(retries),
+        "phases": per_phase,
+        "rates": rates,
+        "compiles": [{k: c.get(k) for k in ("program", "seconds")}
+                     for c in compiles],
+        "final": final,
+        "counters": counters,
+        "run_end": ({k: run_end.get(k) for k in ("rounds", "ok_rounds", "seconds")}
+                    if run_end else None),
+    }
+
+
+def format_summary(summary: dict[str, Any]) -> str:
+    lines: list[str] = []
+    header = summary.get("header") or {}
+    title = f"run {summary.get('run_id') or '<no header>'}"
+    if header:
+        title += (f" — {header.get('model')}/{header.get('data_name')}"
+                  f" mode={header.get('mode')} backend={header.get('backend')}"
+                  f" clients={header.get('total_clients')}")
+    lines.append(title)
+    lines.append(
+        f"rounds: {summary['rounds_attempted']} attempted, "
+        f"{summary['rounds_ok']} ok, {summary['retries']} retried")
+    if summary["phases"]:
+        lines.append(f"{'phase':<14}{'p50':>10}{'p95':>10}{'mean':>10}{'n':>6}")
+        for name, stats in summary["phases"].items():
+            lines.append(
+                f"{name:<14}{stats['p50_s'] * 1e3:>8.1f}ms"
+                f"{stats['p95_s'] * 1e3:>8.1f}ms"
+                f"{stats['mean_s'] * 1e3:>8.1f}ms{stats['count']:>6}")
+    rates = summary["rates"]
+    if rates:
+        parts = []
+        if "rounds_per_sec_steady" in rates:
+            parts.append(f"steady={rates['rounds_per_sec_steady']} "
+                         f"({rates['seconds_per_round_steady']} s/round)")
+        if "rounds_per_sec_incl_compile" in rates:
+            parts.append(f"incl-compile={rates['rounds_per_sec_incl_compile']}")
+        lines.append("rounds/s: " + ", ".join(parts))
+    for compile_event in summary["compiles"]:
+        lines.append(f"compile: {compile_event['program']} "
+                     f"{compile_event['seconds']:.2f}s")
+    if summary["final"]:
+        lines.append("final: " + " ".join(
+            f"{k}={v:.4f}" for k, v in summary["final"].items()))
+    if summary["counters"]:
+        lines.append("counters: " + " ".join(
+            f"{k}={v}" for k, v in summary["counters"].items()))
+    if summary["run_end"]:
+        lines.append(f"run_end: {summary['run_end']['ok_rounds']}/"
+                     f"{summary['run_end']['rounds']} ok in "
+                     f"{summary['run_end']['seconds']:.2f}s")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="attackfl-tpu metrics",
+        description="Summarize a telemetry events.jsonl (per-phase p50/p95, "
+                    "rounds/s steady vs incl-compile, final metric).")
+    parser.add_argument("path", nargs="?", default=".",
+                        help="events.jsonl or a directory containing it")
+    parser.add_argument("--run-id", type=str, default=None,
+                        help="summarize this run instead of the last one")
+    parser.add_argument("--all", action="store_true",
+                        help="summarize every run in the file")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the summary as JSON instead of a table")
+    args = parser.parse_args(argv)
+
+    try:
+        events = load_events(args.path)
+    except FileNotFoundError:
+        print(f"no events.jsonl at {args.path!r}", file=sys.stderr)
+        return 2
+    runs = split_runs(events)
+    if not runs:
+        print(f"no events recorded in {args.path!r}", file=sys.stderr)
+        return 2
+    if args.run_id:
+        runs = [r for r in runs if any(e.get("run_id") == args.run_id for e in r)]
+        if not runs:
+            print(f"run id {args.run_id!r} not found", file=sys.stderr)
+            return 2
+    elif not args.all:
+        runs = runs[-1:]
+
+    summaries = [summarize(run) for run in runs]
+    if args.json:
+        print(json.dumps(summaries if args.all else summaries[0], indent=1))
+    else:
+        print("\n\n".join(format_summary(s) for s in summaries))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,37 @@
+"""Wall-clock phase timing (moved here from ``utils/logging.py``).
+
+``RoundTimer`` keeps its original surface (``phase`` context manager +
+``durations`` dict) and optionally mirrors every phase into a
+:class:`~attackfl_tpu.telemetry.trace.Tracer` span so the same call site
+feeds both the per-round metrics dict and the Chrome trace timeline.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class RoundTimer:
+    """Wall-clock timing of round phases; the observability layer the
+    reference lacks (its only tracing is colored prints, SURVEY.md §5)."""
+
+    def __init__(self, tracer=None):
+        self.durations: dict[str, float] = {}
+        self._tracer = tracer
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            if self._tracer is None:
+                yield
+            else:
+                with self._tracer.span(name):
+                    yield
+        finally:
+            self.durations[name] = (
+                self.durations.get(name, 0.0) + time.perf_counter() - t0)
+
+    def summary(self) -> str:
+        return ", ".join(f"{k}={v * 1e3:.1f}ms" for k, v in self.durations.items())

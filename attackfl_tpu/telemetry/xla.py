@@ -1,0 +1,39 @@
+"""Guarded access to XLA compiled-program introspection.
+
+``compiled.memory_analysis()`` may return None or raise on some
+JAX/backend versions (ADVICE.md finding 3) — this helper is the single
+guard shared by the telemetry compile spans and
+``scripts/config5_footprint.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_BYTE_ATTRS = (
+    ("argument", "argument_size_in_bytes"),
+    ("output", "output_size_in_bytes"),
+    ("temp", "temp_size_in_bytes"),
+    ("alias", "alias_size_in_bytes"),
+    ("generated_code", "generated_code_size_in_bytes"),
+)
+
+
+def memory_analysis_bytes(compiled: Any) -> dict[str, int] | None:
+    """Byte sizes from ``compiled.memory_analysis()``, or None when the
+    backend provides none.  Never raises: telemetry must not take a run
+    down because a backend lacks memory stats."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — unimplemented on some backends
+        return None
+    if analysis is None:
+        return None
+    out: dict[str, int] = {}
+    for key, attr in _BYTE_ATTRS:
+        value = getattr(analysis, attr, None)
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[key] = int(value)
+    return out or None

@@ -16,6 +16,7 @@ reference (which retries forever), retries are capped.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Any, Callable
@@ -36,10 +37,14 @@ from attackfl_tpu.parallel.mesh import (
     make_constrain, replicate_to_mesh,
 )
 from attackfl_tpu.registry import get_model
+from attackfl_tpu.telemetry import Logger, RoundTimer, Telemetry, print_with_color
+from attackfl_tpu.telemetry.xla import memory_analysis_bytes
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
-from attackfl_tpu.training.round import build_aggregator, build_attack_groups, build_round_step
+from attackfl_tpu.training.round import (
+    active_attack_modes, build_aggregator, build_attack_groups,
+    build_round_step, describe_attack_groups,
+)
 from attackfl_tpu.utils import checkpoint as ckpt
-from attackfl_tpu.utils.logging import Logger, RoundTimer, print_with_color
 
 MAX_ROUND_RETRIES = 20
 # run_fast dispatch granularity: one compiled scan of this many rounds
@@ -70,6 +75,7 @@ class Simulator:
         logger: Logger | None = None,
         use_mesh: bool = False,
         mesh=None,
+        telemetry: Telemetry | None = None,
     ):
         self.cfg = cfg
         self.logger = logger or Logger(f"{cfg.log_path}/app.log")
@@ -134,10 +140,27 @@ class Simulator:
             )
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
+        # ---- telemetry --------------------------------------------------
+        # Under a multi-host mesh every process runs this Simulator SPMD;
+        # only process 0 writes event/trace files (per-process logs are the
+        # ROADMAP's multi-host-aggregation open item).
+        if telemetry is not None:
+            self.telemetry = telemetry
+        elif self.multiprocess and jax.process_index() != 0:
+            self.telemetry = Telemetry.disabled()
+        else:
+            self.telemetry = Telemetry.from_config(cfg)
+        self._header_emitted = False
+        self._nan_counter: Callable | None = None
+        # AOT-compiled fused chunk programs, keyed by scan length (False =
+        # AOT failed for this length; fall back to the lazy jit path)
+        self._fused_exe_cache: dict[int, Any] = {}
+
         # ---- validation -------------------------------------------------
         self.validation = None
         if cfg.validation:
-            self.validation = Validation(self.model, cfg.data_name, test_np, self.logger)
+            self.validation = Validation(self.model, cfg.data_name, test_np,
+                                         self.logger, telemetry=self.telemetry)
 
         # ---- mode-specific programs ------------------------------------
         self.is_hyper = cfg.mode == "hyper"
@@ -275,6 +298,66 @@ class Simulator:
         return state
 
     # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def _emit_run_header(self) -> None:
+        """First event of a run: config + backend/device info + the static
+        program/attacker geometry (host-known values only)."""
+        tel = self.telemetry
+        if self._header_emitted or not tel.enabled:
+            return
+        self._header_emitted = True
+        programs = {}
+        for name, fn in (("round_step", getattr(self, "_round_step_raw", None)),
+                         ("aggregate", getattr(self, "_aggregate_raw", None)),
+                         ("hyper_update", getattr(self, "_hyper_update_raw", None))):
+            info = getattr(fn, "telemetry_info", None)
+            if info:
+                programs[name] = info
+        tel.events.emit(
+            "run_header",
+            backend=jax.default_backend(),
+            num_devices=len(jax.devices()),
+            mesh_devices=self.mesh.size if self.mesh is not None else 0,
+            multiprocess=self.multiprocess,
+            mode=self.cfg.mode,
+            model=self.cfg.model,
+            data_name=self.cfg.data_name,
+            total_clients=self.cfg.total_clients,
+            attacks=describe_attack_groups(self.attack_groups),
+            programs=programs,
+            jax_version=jax.__version__,
+            config=dataclasses.asdict(self.cfg),
+        )
+
+    def _count_nan_clients(self, stacked) -> int:
+        """How many clients' stacked updates contain non-finite values —
+        computed on the failure path only (one jitted reduction)."""
+        if self._nan_counter is None:
+            def count(tree):
+                flat = pt.tree_ravel_stacked(tree)
+                return jnp.sum(~jnp.all(jnp.isfinite(flat), axis=1))
+
+            self._nan_counter = jax.jit(count)
+        return int(self._nan_counter(stacked))
+
+    def _finish_run(self, history: list[dict[str, Any]], t_start: float) -> None:
+        """Terminal events of a run()/run_fast() call: the counters
+        snapshot, a run_end record, and the Chrome trace file."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        tel.events.emit("counters", counters=tel.counters.snapshot())
+        tel.events.emit(
+            "run_end",
+            rounds=len(history),
+            ok_rounds=sum(1 for h in history if h.get("ok")),
+            seconds=round(time.perf_counter() - t_start, 6),
+        )
+        tel.flush()
+
+    # ------------------------------------------------------------------
     # one round
     # ------------------------------------------------------------------
 
@@ -284,12 +367,15 @@ class Simulator:
         host (one all-gather over DCN) and let process 0 alone write the
         file — every process participates in the gather collective."""
         path = ckpt.checkpoint_path(self.cfg)
-        if self.multiprocess:
-            host = gather_to_host(state)
-            if jax.process_index() == 0:
-                ckpt.save_state(path, host)
-        else:
-            ckpt.save_state(path, state)
+        with self.telemetry.tracer.span("checkpoint"):
+            if self.multiprocess:
+                host = gather_to_host(state)
+                if jax.process_index() == 0:
+                    ckpt.save_state(path, host)
+            else:
+                ckpt.save_state(path, state)
+        self.telemetry.counters.inc("checkpoint_writes")
+        self.telemetry.events.emit("checkpoint", path=path)
 
     def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
         """Execute one broadcast->train->attack->aggregate->validate round.
@@ -300,6 +386,7 @@ class Simulator:
         the reference's retry path (server.py:546-567).
         """
         cfg = self.cfg
+        self._emit_run_header()
         t0 = time.perf_counter()
         if cfg.reload_parameters_per_round and not self.is_hyper:
             # reference fidelity (server.py:578-586): with parameters.load,
@@ -321,20 +408,28 @@ class Simulator:
         metrics: dict[str, Any] = {"round": int(state["completed_rounds"]) + 1,
                                    "broadcast": broadcast_number}
 
-        if self.is_hyper:
-            new_state, metrics = self._run_hyper_round(
-                state, rng, k_round, broadcast_number, metrics
-            )
-        else:
-            new_state, metrics = self._run_plain_round(
-                state, rng, k_round, k_agg, broadcast_number, metrics
-            )
+        with self.telemetry.tracer.span("round", round=metrics["round"],
+                                        broadcast=broadcast_number):
+            if self.is_hyper:
+                new_state, metrics = self._run_hyper_round(
+                    state, rng, k_round, broadcast_number, metrics
+                )
+            else:
+                new_state, metrics = self._run_plain_round(
+                    state, rng, k_round, k_agg, broadcast_number, metrics
+                )
         metrics["seconds"] = time.perf_counter() - t0
+        self.telemetry.events.round_event(metrics)
         return new_state, metrics
 
     def _run_plain_round(self, state, rng, k_round, k_agg, broadcast_number, metrics):
         cfg = self.cfg
-        timer = RoundTimer()
+        tel = self.telemetry
+        timer = RoundTimer(tracer=tel.tracer)
+        if self.attack_groups:
+            metrics["attacks_active"] = active_attack_modes(
+                self.attack_groups, broadcast_number,
+                bool(state["have_genuine"]))
         with timer.phase("train"):
             stacked, sizes, new_genuine, ok, loss = self.round_step(
                 state["global_params"], state["prev_genuine"],
@@ -343,19 +438,29 @@ class Simulator:
             )
             ok = train_ok = bool(ok)  # blocks on the dispatched program
         metrics["train_loss"] = float(loss)
+        if not train_ok:
+            tel.counters.inc("nan_train_rounds")
+            if tel.enabled:
+                nan_clients = self._count_nan_clients(stacked)
+                metrics["nan_clients"] = nan_clients
+                tel.counters.inc("nan_clients_detected", nan_clients)
 
         weights_mask = jnp.ones((cfg.total_clients,), jnp.float32)
         if ok and cfg.mode == "gmm":
-            flat = np.asarray(self._ravel_stacked(stacked))
-            keep = defenses.gmm_filter(flat, self.attacker_mask, seed=cfg.random_seed)
+            with timer.phase("defense"):
+                flat = np.asarray(self._ravel_stacked(stacked))
+                keep = defenses.gmm_filter(flat, self.attacker_mask, seed=cfg.random_seed)
             metrics["gmm_kept"] = int(keep.sum())
+            tel.counters.inc("anomalies_removed", cfg.total_clients - int(keep.sum()))
             if not keep.any():
                 ok = False  # round fails when no client survives (server.py:369-372)
             weights_mask = jnp.asarray(keep, jnp.float32)
         elif ok and cfg.mode == "fltracer":
-            flat = np.asarray(self._ravel_stacked(stacked))
-            anomalies = defenses.fltracer_anomalies(flat)
+            with timer.phase("defense"):
+                flat = np.asarray(self._ravel_stacked(stacked))
+                anomalies = defenses.fltracer_anomalies(flat)
             metrics["fltracer_anomalies"] = anomalies.tolist()
+            tel.counters.inc("anomalies_removed", len(anomalies))
             mask = np.ones(cfg.total_clients, np.float32)
             mask[anomalies] = 0.0
             if not mask.any():
@@ -402,7 +507,12 @@ class Simulator:
 
     def _run_hyper_round(self, state, rng, k_round, broadcast_number, metrics):
         cfg = self.cfg
-        timer = RoundTimer()
+        tel = self.telemetry
+        timer = RoundTimer(tracer=tel.tracer)
+        if self.attack_groups:
+            metrics["attacks_active"] = active_attack_modes(
+                self.attack_groups, broadcast_number,
+                bool(state["have_genuine"]))
         active_mask = jnp.asarray(state["active_mask"])
         with timer.phase("train"):
             stacked, sizes, new_genuine, ok, loss = self.round_step(
@@ -412,6 +522,12 @@ class Simulator:
             )
             ok = train_ok = bool(ok)
         metrics["train_loss"] = float(loss)
+        if not train_ok:
+            tel.counters.inc("nan_train_rounds")
+            if tel.enabled:
+                nan_clients = self._count_nan_clients(stacked)
+                metrics["nan_clients"] = nan_clients
+                tel.counters.inc("nan_clients_detected", nan_clients)
 
         # snapshot for detection rollback (reference: server.py:296-298)
         prev_hnet = state["hnet_params"] if self.detector is not None else None
@@ -434,9 +550,19 @@ class Simulator:
                     selected = [int(i) for i in np.flatnonzero(new_active > 0)]
                     emb_np = np.asarray(embeddings)[selected]
                     removals = self.detector.observe(broadcast_number, selected, emb_np)
+                if tel.enabled:
+                    # per-client anomaly signal: embedding L2 norms of this
+                    # round's selected clients (host-side, already gathered)
+                    metrics["embedding_norms"] = {
+                        cid: round(float(n), 6) for cid, n in
+                        zip(selected, np.linalg.norm(emb_np, axis=1))
+                    }
                 if removals:
                     print_with_color(f"Removing anomalies {removals}, rolling back", "yellow")
                     metrics["removed_clients"] = removals
+                    tel.counters.inc("anomalies_removed", len(removals))
+                    tel.events.emit("rollback", removed=list(removals),
+                                    broadcast=broadcast_number)
                     for cid in removals:
                         new_active[cid] = 0.0
                     hnet_params, opt_state = prev_hnet, prev_opt
@@ -601,6 +727,7 @@ class Simulator:
     def _fused_chunk(self, length: int) -> Callable:
         fn = self._fused_cache.get(length)
         if fn is None:
+            self.telemetry.counters.inc("round_program_cache_misses")
             body = self._build_fused_body()
 
             def chunk(state):
@@ -608,7 +735,41 @@ class Simulator:
 
             fn = jax.jit(chunk, donate_argnums=0)
             self._fused_cache[length] = fn
+        else:
+            self.telemetry.counters.inc("round_program_cache_hits")
         return fn
+
+    def _fused_executable(self, length: int, fn: Callable, state) -> Any:
+        """AOT-compile the fused chunk under a telemetry compile span
+        (explicit compile-vs-dispatch split + guarded memory stats).
+
+        Only used when telemetry is on and no mesh is involved (AOT
+        executables pin input shardings; the lazy jit path re-shards
+        freely).  Returns the executable, or False when AOT failed — the
+        caller then falls back to the jitted ``fn`` permanently."""
+        exe = self._fused_exe_cache.get(length)
+        if exe is None:
+            tel = self.telemetry
+            label = f"fused_scan[{length}]"
+            t0 = time.perf_counter()
+            try:
+                with tel.tracer.span("compile", program=label):
+                    exe = fn.lower(state).compile()
+            except Exception as e:  # noqa: BLE001 — AOT is best-effort
+                exe = False
+                tel.events.emit("compile", program=label,
+                                seconds=round(time.perf_counter() - t0, 6),
+                                error=f"{type(e).__name__}: {e}"[:300])
+            else:
+                event = {"program": label,
+                         "seconds": round(time.perf_counter() - t0, 6),
+                         "scan_length": length}
+                memory = memory_analysis_bytes(exe)
+                if memory:
+                    event["memory_bytes"] = memory
+                tel.events.emit("compile", **event)
+            self._fused_exe_cache[length] = exe
+        return exe
 
     def _canonical_device_state(self, state: dict[str, Any]) -> dict[str, Any]:
         """Cast host-typed counters/flags so the fused carry has stable
@@ -647,7 +808,12 @@ class Simulator:
                 "run?); use run_round/run for active-mask-aware validation"
             )
         fn = self._fused_chunk(num_broadcasts)
-        return fn(self._canonical_device_state(state))
+        state = self._canonical_device_state(state)
+        if self.telemetry.enabled and self.mesh is None:
+            exe = self._fused_executable(num_broadcasts, fn, state)
+            if exe is not False:
+                return exe(state)
+        return fn(state)
 
     def run_fast(
         self,
@@ -672,8 +838,10 @@ class Simulator:
         the device program — do not reuse it after this call.
         """
         cfg = self.cfg
+        tel = self.telemetry
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
         state = state if state is not None else self.load_or_init_state()
+        self._emit_run_header()
         history: list[dict[str, Any]] = []
         consecutive_failures = 0  # run()'s retry counter semantics
         first_dispatch = True
@@ -695,15 +863,26 @@ class Simulator:
             else:
                 n = 1
             first_dispatch = False
+            # compile happens on this chunk length's first dispatch —
+            # either AOT inside run_scan (telemetry on) or lazily at the
+            # jitted call (telemetry off); flag the chunk either way so
+            # the metrics CLI can split steady vs incl-compile rates
+            includes_compile = (n not in self._fused_cache
+                                and n not in self._fused_exe_cache)
             t0 = time.perf_counter()
-            state, metrics = self.run_scan(state, n)
-            # dispatch is ASYNC (CPU backend included): without blocking,
-            # `elapsed` measures enqueue time (~10 ms) while the actual
-            # rounds run inside the np.asarray sync below, making
-            # chunk_seconds fiction.  Block inside the timed section.
-            jax.block_until_ready(metrics)
+            with tel.tracer.span("chunk", chunk_len=n):
+                state, metrics = self.run_scan(state, n)
+                # dispatch is ASYNC (CPU backend included): without
+                # blocking, `elapsed` measures enqueue time (~10 ms) while
+                # the actual rounds run inside the np.asarray sync below,
+                # making chunk_seconds fiction.  Block inside the timed
+                # section.
+                jax.block_until_ready(metrics)
             elapsed = time.perf_counter() - t0
+            tel.events.emit("chunk", chunk_len=n, seconds=round(elapsed, 6),
+                            includes_compile=includes_compile)
             host = {k: np.asarray(v) for k, v in metrics.items()}
+            broadcasts_after = int(state["broadcasts"])
             for i in range(n):
                 entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
                          for k, v in host.items()}
@@ -713,12 +892,17 @@ class Simulator:
                 # (run()'s per-entry "seconds" IS genuine, engine.py:286).
                 entry["chunk_seconds"] = elapsed
                 entry["chunk_len"] = n
+                entry["round"] = len(history) + 1  # attempt index
+                entry["broadcast"] = broadcasts_after - n + i + 1
                 history.append(entry)
+                tel.events.round_event(entry)
                 if entry["ok"]:
                     consecutive_failures = 0
                 else:
                     consecutive_failures += 1
+                    tel.counters.inc("rounds_failed")
             if consecutive_failures > MAX_ROUND_RETRIES:
+                self._finish_run(history, t_start)
                 raise RuntimeError(
                     f"round failed {consecutive_failures} times in a row; "
                     "aborting (the reference would retry forever, "
@@ -739,6 +923,7 @@ class Simulator:
                 print_with_color(
                     f"[fast] {done}/{num_rounds} rounds, chunk of {n} in "
                     f"{elapsed:.2f}s ({elapsed / n:.3f}s/round) {msg}", "green")
+        self._finish_run(history, t_start)
         return state, history
 
     # ------------------------------------------------------------------
@@ -757,8 +942,10 @@ class Simulator:
         cfg = self.cfg
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
         state = state if state is not None else self.load_or_init_state()
+        self._emit_run_header()
         history: list[dict[str, Any]] = []
         retries = 0
+        t_start = time.perf_counter()
         self.logger.log_info("### Application start ###")
 
         while int(state["completed_rounds"]) < num_rounds:
@@ -782,11 +969,17 @@ class Simulator:
                         f"Round {round_no} done in {metrics['seconds']:.2f}s {msg}", "green")
             else:
                 retries += 1
+                self.telemetry.counters.inc("rounds_failed")
+                self.telemetry.counters.inc("rounds_retried")
+                self.telemetry.events.emit("retry", round=round_no,
+                                           retries=retries)
                 print_with_color("Training failed!", "yellow")
                 self.logger.log_warning(f"Round {round_no} failed (retry {retries})")
                 if retries > MAX_ROUND_RETRIES:
+                    self._finish_run(history, t_start)
                     raise RuntimeError(
                         f"Round {round_no} failed {retries} times; aborting "
                         "(the reference would retry forever, server.py:546-556)"
                     )
+        self._finish_run(history, t_start)
         return state, history

@@ -186,6 +186,16 @@ def build_hyper_round(
         loss = jnp.sum(losses * participating) / jnp.maximum(jnp.sum(participating), 1.0)
         return stacked, sizes, new_genuine, ok, loss
 
+    # host-side program metadata for the telemetry run header (never read
+    # inside the traced function)
+    round_step.telemetry_info = {
+        "program": "hyper_round_step",
+        "clients": num_clients,
+        "leak_k": leak_k,
+        "attack_groups": len(attack_groups),
+        "dropout_rate": drop_rate,
+        "detector": bool(cfg.hyper_detection.enable),
+    }
     return round_step, generate_all
 
 
@@ -237,6 +247,8 @@ def build_hyper_update(
             return (jax.tree.map(sel, new_hp, hnet_params),
                     jax.tree.map(sel, new_opt, opt_state))
 
+        hyper_update.telemetry_info = {"program": "hyper_update[batched]",
+                                       "clients": num_clients}
         return hyper_update, tx
 
     def hyper_update(hnet_params, opt_state, stacked_client_params, active_mask):
@@ -257,4 +269,6 @@ def build_hyper_update(
         (hnet_params, opt_state), _ = jax.lax.scan(body, (hnet_params, opt_state), xs)
         return hnet_params, opt_state
 
+    hyper_update.telemetry_info = {"program": "hyper_update[sequential]",
+                                   "clients": num_clients}
     return hyper_update, tx

@@ -97,6 +97,31 @@ def build_attack_groups(cfg: Config) -> tuple[list[AttackGroup], list[int]]:
     return group_list, genuine
 
 
+def describe_attack_groups(groups: Sequence[AttackGroup]) -> list[dict[str, Any]]:
+    """JSON-ready attacker geometry for the telemetry run header."""
+    return [
+        {
+            "mode": g.mode,
+            "num_clients": len(g.indices),
+            "indices": list(g.indices),
+            "attack_round": g.attack_round,
+            "args": list(g.args),
+        }
+        for g in groups
+    ]
+
+
+def active_attack_modes(groups: Sequence[AttackGroup], broadcast_number: int,
+                        have_genuine: bool) -> list[str]:
+    """Attack modes firing at this broadcast — the host-side mirror of the
+    per-group ``active`` gate inside round_step (attackers need a leaked
+    genuine set, so nothing fires before one exists)."""
+    if not have_genuine:
+        return []
+    return sorted({g.mode for g in groups
+                   if broadcast_number >= g.attack_round})
+
+
 def build_round_step(
     model,
     cfg: Config,
@@ -249,6 +274,16 @@ def build_round_step(
         # (the reference analog is a barrier deadlock, server.py:271-272)
         return stacked, sizes, new_genuine, jnp.all(ok) & jnp.any(kept), mean_loss
 
+    # host-side program metadata for the telemetry run header (never read
+    # inside the traced function)
+    round_step.telemetry_info = {
+        "program": "plain_round_step",
+        "local_backend": cfg.local_backend,
+        "clients": num_clients,
+        "leak_k": leak_k,
+        "attack_groups": len(attack_groups),
+        "dropout_rate": drop_rate,
+    }
     return round_step
 
 
@@ -326,4 +361,6 @@ def build_aggregator(
     else:
         raise ValueError(f"Server mode '{mode}' is not valid.")
 
+    aggregate.telemetry_info = {"program": f"aggregate[{mode}]",
+                                "geo_mask": geo_mask}
     return aggregate

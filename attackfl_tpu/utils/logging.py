@@ -1,72 +1,13 @@
-"""Structured logging + colored console output.
+"""Compatibility shim: logging/timing moved into the telemetry subsystem.
 
-Parity with the reference's ``src/Log.py`` (Logger writing app.log and
-``print_with_color`` ANSI console prints, Log.py:15-44), extended with the
-round/step timing the reference lacks (SURVEY.md §5: "no timers").
+``Logger`` and ``print_with_color`` live in
+:mod:`attackfl_tpu.telemetry.console`, ``RoundTimer`` in
+:mod:`attackfl_tpu.telemetry.timing`.  Import from
+:mod:`attackfl_tpu.telemetry` going forward; this module re-exports the
+original names so existing imports keep working.
 """
 
-from __future__ import annotations
+from attackfl_tpu.telemetry.console import Logger, print_with_color  # noqa: F401
+from attackfl_tpu.telemetry.timing import RoundTimer  # noqa: F401
 
-import logging
-import os
-import time
-from contextlib import contextmanager
-
-_COLORS = {
-    "red": "\033[91m",
-    "green": "\033[92m",
-    "yellow": "\033[93m",
-    "blue": "\033[94m",
-    "magenta": "\033[95m",
-    "cyan": "\033[96m",
-}
-_RESET = "\033[0m"
-
-
-def print_with_color(text: str, color: str = "cyan") -> None:
-    print(f"{_COLORS.get(color, '')}{text}{_RESET}")
-
-
-class Logger:
-    """File logger writing ``app.log`` under ``log_path``
-    (reference: server.py:89,175; src/Log.py:15-39)."""
-
-    def __init__(self, path: str = "./app.log"):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._logger = logging.getLogger(f"attackfl_tpu.{path}")
-        self._logger.setLevel(logging.INFO)
-        self._logger.propagate = False
-        if not self._logger.handlers:
-            handler = logging.FileHandler(path)
-            handler.setFormatter(
-                logging.Formatter("%(asctime)s - %(levelname)s - %(message)s")
-            )
-            self._logger.addHandler(handler)
-
-    def log_info(self, msg: str) -> None:
-        self._logger.info(msg)
-
-    def log_warning(self, msg: str) -> None:
-        self._logger.warning(msg)
-
-    def log_error(self, msg: str) -> None:
-        self._logger.error(msg)
-
-
-class RoundTimer:
-    """Wall-clock timing of round phases; the observability layer the
-    reference lacks (its only tracing is colored prints, SURVEY.md §5)."""
-
-    def __init__(self):
-        self.durations: dict[str, float] = {}
-
-    @contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.durations[name] = self.durations.get(name, 0.0) + time.perf_counter() - t0
-
-    def summary(self) -> str:
-        return ", ".join(f"{k}={v * 1e3:.1f}ms" for k, v in self.durations.items())
+__all__ = ["Logger", "RoundTimer", "print_with_color"]

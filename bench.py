@@ -132,10 +132,11 @@ def tpu_init_watchdog(metric: str, seconds: float = 600.0):
                 "cpu_evidence_committed": evidence,
                 "probe_log": "tpu_probe.log",
             }
-            print(json.dumps({
-                "metric": metric, "value": 0.0, "unit": "rounds/s",
-                "vs_baseline": 0.0, "detail": detail,
-            }), flush=True)
+            from attackfl_tpu.telemetry import metric_line
+
+            print(json.dumps(metric_line(
+                metric, 0.0, unit="rounds/s", vs_baseline=0.0, detail=detail,
+            )), flush=True)
             os._exit(2)
 
     timer = threading.Timer(seconds, _boom)
@@ -325,13 +326,15 @@ def main() -> None:
         value = max((r for _, r in best), default=0.0)
         vs_key = ("vs_north_star_incl_compile" if incl_compile
                   else "vs_baseline")
-        print(json.dumps({
-            "metric": metric_name, "value": value, "unit": "rounds/s",
-            vs_key: round(value / NORTH_STAR_ROUNDS_PER_SEC, 4),
-            "detail": {**partial,
-                       "error": f"deadline {args.deadline:.0f}s expired "
-                                "(TPU dispatch wedged?); partial results"},
-        }), flush=True)
+        from attackfl_tpu.telemetry import metric_line
+
+        print(json.dumps(metric_line(
+            metric_name, value, unit="rounds/s",
+            **{vs_key: round(value / NORTH_STAR_ROUNDS_PER_SEC, 4)},
+            detail={**partial,
+                    "error": f"deadline {args.deadline:.0f}s expired "
+                             "(TPU dispatch wedged?); partial results"},
+        )), flush=True)
         os._exit(3)
 
     import threading
@@ -347,19 +350,19 @@ def main() -> None:
     on_tpu = is_tpu_backend()  # axon registers as "axon", not "tpu"
     cancel_watchdog()
 
+    from attackfl_tpu.telemetry import metric_line
+
     def finish(res: dict, value_key: str = "rounds_per_sec",
                vs_key: str = "vs_baseline") -> None:
         # vs_key: --e2e-rounds divides an including-compile rate by the
         # steady-state north-star constant; label it distinctly so table
         # consumers don't compare incompatible denominators (ADVICE r3 #3)
         deadline_timer.cancel()
-        print(json.dumps({
-            "metric": metric_name,
-            "value": res[value_key],
-            "unit": "rounds/s",
-            vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4),
-            "detail": res,
-        }))
+        print(json.dumps(metric_line(
+            metric_name, res[value_key], unit="rounds/s",
+            **{vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4)},
+            detail=res,
+        )))
 
     if args.north_star:  # 1000-client row (BASELINE.json target workload)
         cfg = north_star_config()
@@ -470,13 +473,11 @@ def main() -> None:
             detail["north_star_1000c"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     deadline_timer.cancel()
-    print(json.dumps({
-        "metric": metric_name,
-        "value": best["rounds_per_sec"],
-        "unit": "rounds/s",
-        "vs_baseline": round(best["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
-        "detail": detail,
-    }))
+    print(json.dumps(metric_line(
+        metric_name, best["rounds_per_sec"], unit="rounds/s",
+        vs_baseline=round(best["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
+        detail=detail,
+    )))
 
 
 if __name__ == "__main__":

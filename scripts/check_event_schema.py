@@ -1,0 +1,68 @@
+"""Lint telemetry artifacts: validate every ``events.jsonl`` under the
+given paths (default: the repo root, i.e. committed bench artifacts)
+against the telemetry event schema
+(``attackfl_tpu.telemetry.events.REQUIRED_FIELDS``).
+
+Usage: python scripts/check_event_schema.py [path ...]
+Exit 0 when every line of every found file validates; 1 otherwise.
+A path may be a directory (searched recursively for ``events.jsonl`` /
+``*.events.jsonl``) or a single file to validate directly.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from attackfl_tpu.telemetry.events import validate_event  # noqa: E402
+
+
+def find_event_files(path: Path) -> list[Path]:
+    if path.is_file():
+        return [path]
+    return sorted(set(path.rglob("events.jsonl")) |
+                  set(path.rglob("*.events.jsonl")))
+
+
+def check_file(path: Path) -> list[str]:
+    errors: list[str] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: not JSON ({e})")
+                continue
+            for problem in validate_event(record):
+                errors.append(f"{path}:{lineno}: {problem}")
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    roots = [Path(a) for a in args] or [REPO]
+    files: list[Path] = []
+    for root in roots:
+        if not root.exists():
+            print(f"error: no such path {root}", file=sys.stderr)
+            return 1
+        files.extend(find_event_files(root))
+    errors: list[str] = []
+    for path in files:
+        errors.extend(check_file(path))
+    for problem in errors:
+        print(problem)
+    print(f"checked {len(files)} file(s): "
+          f"{'OK' if not errors else f'{len(errors)} schema violation(s)'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

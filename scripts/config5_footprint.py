@@ -82,7 +82,13 @@ def main() -> None:
     ex = (state["global_params"], state["prev_genuine"],
           jnp.asarray(True), k_round, jnp.asarray(1))
     compiled = sim.round_step.lower(*ex).compile()
-    ma = compiled.memory_analysis()
+    # memory_analysis() may return None or raise on some JAX/backend
+    # versions (ADVICE.md finding 3); the telemetry compile spans share
+    # this guard.  The measured per-client array bytes below are
+    # backend-independent and must survive missing XLA stats.
+    from attackfl_tpu.telemetry.xla import memory_analysis_bytes
+
+    ma = memory_analysis_bytes(compiled)
     compile_s = time.time() - t0
 
     n = cfg.total_clients
@@ -93,11 +99,9 @@ def main() -> None:
                    "batch_size": cfg.batch_size,
                    "num_data_range": list(cfg.num_data_range)},
         "compile_s": round(compile_s, 1),
-        "xla_memory_stats_bytes": {
-            "argument": int(ma.argument_size_in_bytes),
-            "output": int(ma.output_size_in_bytes),
-            "temp": int(ma.temp_size_in_bytes),
-            "alias": int(ma.alias_size_in_bytes),
+        "xla_memory_stats_bytes": ma if ma is not None else {
+            "unavailable": "memory_analysis() returned None or raised on "
+                           "this JAX/backend version",
         },
         "measured_per_client_bytes": {
             "resnet18_params_f32": params_b,

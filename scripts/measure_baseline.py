@@ -136,6 +136,20 @@ def main() -> None:
             continue
         out[name] = run_step(argv, args.step_timeout)
         print(json.dumps({name: out[name]}), flush=True)
+        # prove-or-demote actually enforced (ADVICE.md finding 2): a failed
+        # or invalid pallas_validate step must keep the timed kernel row
+        # out of the table — a timed-but-invalid kernel reads as a result.
+        if name == "pallas_validate":
+            row = out[name]
+            failed = bool(row.get("error")) or row.get("ok") is False
+            if failed:
+                skip.add("config4_pallas")
+                out["config4_pallas"] = {
+                    "skipped": "pallas_validate failed; timed-but-invalid "
+                               "kernel row withheld",
+                }
+                print(json.dumps({"config4_pallas": out["config4_pallas"]}),
+                      flush=True)
 
     Path(args.out).write_text(json.dumps(out, indent=1))
     print(json.dumps(out))

@@ -41,3 +41,20 @@ def _isolate_artifacts(tmp_path_factory):
     os.chdir(workdir)
     yield
     os.chdir(old)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _isolate_telemetry(tmp_path_factory):
+    """Route telemetry output (events.jsonl / trace.json) to a tmp dir:
+    ATTACKFL_TELEMETRY_DIR overrides every Simulator's log_path-derived
+    telemetry base (telemetry/core.py), so tests that construct Simulators
+    with default paths can't litter the repo root.  Tests asserting on
+    telemetry files monkeypatch this env var to their own tmp_path."""
+    tdir = tmp_path_factory.mktemp("telemetry")
+    old = os.environ.get("ATTACKFL_TELEMETRY_DIR")
+    os.environ["ATTACKFL_TELEMETRY_DIR"] = str(tdir)
+    yield str(tdir)
+    if old is None:
+        os.environ.pop("ATTACKFL_TELEMETRY_DIR", None)
+    else:
+        os.environ["ATTACKFL_TELEMETRY_DIR"] = old

@@ -155,6 +155,21 @@ def test_byzantine_tolerance_fallback_to_all():
                                    np.asarray(t[k]).mean(0), rtol=1e-5)
 
 
+def test_byzantine_tolerance_all_zero_mask_stays_finite():
+    """Regression (ADVICE.md finding 1): with an all-zero participation
+    mask the keep=maskf fallback was still all-zero, so tree_weighted_mean
+    divided by 0 and returned NaN params.  The degenerate case now falls
+    back to an unweighted mean and must stay finite (the fused scan body
+    evaluates the aggregate unconditionally before discarding the round)."""
+    t = stacked_tree(4, seed=3)
+    mask = jnp.zeros((4,), jnp.float32)
+    out = jax.jit(lambda t, m: agg.byzantine_tolerance(t, 0.9, m))(t, mask)
+    for k in t:
+        got = np.asarray(out[k])
+        assert np.all(np.isfinite(got)), f"NaN/inf in {k}"
+        np.testing.assert_allclose(got, np.asarray(t[k]).mean(0), rtol=1e-5)
+
+
 def test_byzantine_tolerance_masked_equals_subset():
     """With a participation mask the anchor moves to the first VALID row
     and the result equals the unmasked rule over the valid subset."""
