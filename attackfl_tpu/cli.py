@@ -17,12 +17,16 @@ section instead.
 * ``attackfl-tpu run [--config ...] [--rounds N]`` — run the federation
   with attackers from the config (no rendezvous), telemetry on by default;
 * ``attackfl-tpu server`` / ``attackfl-tpu client`` — the rendezvous pair;
-* ``attackfl-tpu metrics <dir>`` — summarize a run's ``events.jsonl``.
+* ``attackfl-tpu metrics <dir>`` — summarize a run's ``events.jsonl``
+  (``--merge`` for cross-host skew, ``--forensics`` for defense TPR/FPR);
+* ``attackfl-tpu watch`` — poll a live run's monitor endpoint
+  (``--monitor`` on run/server) and print each new round as it lands.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -132,6 +136,16 @@ def server_main(argv=None) -> None:
     parser.add_argument("--no-wait", action="store_true",
                         help="skip client rendezvous; attackers come from config")
     parser.add_argument("--rounds", type=int, default=None, help="override num-round")
+    # --- observability overrides (config: telemetry: section) ---
+    parser.add_argument("--monitor", action="store_true",
+                        help="serve /healthz /metrics /last-round + stall "
+                             "watchdog (telemetry.monitor)")
+    parser.add_argument("--monitor-port", type=int, default=None,
+                        help="monitor port (0 = ephemeral, printed at start)")
+    parser.add_argument("--profile-rounds", type=str, default=None,
+                        metavar="A:B",
+                        help="wrap rounds A..B in jax.profiler device "
+                             "tracing (output: <telemetry dir>/profile)")
     # --- multi-host (DCN) scale-out: one process per host, same command
     # with a distinct --process-id (parallel/mesh.distributed_init) ---
     parser.add_argument("--coordinator", type=str, default=None,
@@ -165,6 +179,17 @@ def server_main(argv=None) -> None:
         distributed_init(args.coordinator, args.num_processes, args.process_id)
 
     cfg = load_config(args.config)
+    overrides = {}
+    if args.monitor:
+        overrides["monitor"] = True
+    if args.monitor_port is not None:
+        overrides["monitor"] = True
+        overrides["monitor_port"] = args.monitor_port
+    if args.profile_rounds is not None:
+        overrides["profile_rounds"] = args.profile_rounds
+    if overrides:
+        cfg = cfg.replace(
+            telemetry=dataclasses.replace(cfg.telemetry, **overrides))
     base = os.path.dirname(os.path.abspath(args.config))
 
     if not args.no_wait:
@@ -175,15 +200,18 @@ def server_main(argv=None) -> None:
     from attackfl_tpu.training.engine import Simulator
 
     sim = Simulator(cfg, use_mesh=True)
-    state, history = sim.run(num_rounds=args.rounds)
+    try:
+        state, history = sim.run(num_rounds=args.rounds)
+    finally:
+        if sim.telemetry.enabled:
+            print_with_color(
+                f"Telemetry: {sim.telemetry.events.path} "
+                f"(summarize with `attackfl-tpu metrics`), trace: "
+                f"{sim.telemetry.tracer.path} (open in https://ui.perfetto.dev)",
+                "cyan")
+        sim.close()
     ok_rounds = sum(1 for h in history if h["ok"])
     print_with_color(f"Finished: {ok_rounds} successful rounds.", "green")
-    if sim.telemetry.enabled:
-        print_with_color(
-            f"Telemetry: {sim.telemetry.events.path} "
-            f"(summarize with `attackfl-tpu metrics`), trace: "
-            f"{sim.telemetry.tracer.path} (open in https://ui.perfetto.dev)",
-            "cyan")
 
 
 def run_main(argv=None) -> None:
@@ -194,10 +222,76 @@ def run_main(argv=None) -> None:
 
 
 def metrics_main(argv=None) -> int:
-    """``attackfl-tpu metrics``: summarize a run's events.jsonl."""
+    """``attackfl-tpu metrics``: summarize a run's events.jsonl
+    (``--merge`` for multi-host skew, ``--forensics`` for defense
+    TPR/FPR)."""
     from attackfl_tpu.telemetry.summary import main as summary_main
 
     return summary_main(list(sys.argv[1:] if argv is None else argv))
+
+
+def _http_get_json(url: str, timeout: float = 5.0):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode() or "{}")
+
+
+def watch_main(argv=None) -> int:
+    """``attackfl-tpu watch``: thin poller of a live run's monitor
+    endpoint (``--monitor`` on run/server) — prints each new round as it
+    completes and shouts when ``/healthz`` flips to stalled.  This
+    replaces the retired ``scripts/tpu_watch.sh`` loop: liveness now comes
+    from the run itself, not from out-of-process probe jobs."""
+    import urllib.error
+
+    parser = argparse.ArgumentParser(
+        prog="attackfl-tpu watch",
+        description="Poll a running simulation's monitor endpoint.")
+    parser.add_argument("url", nargs="?", default="http://127.0.0.1:8780",
+                        help="monitor base URL (printed at run start)")
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="poll period in seconds (default 5)")
+    parser.add_argument("--once", action="store_true",
+                        help="single poll: exit 0 healthy, 1 stalled, "
+                             "2 unreachable")
+    args = parser.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    seen_round = object()
+    stalled = False
+    while True:
+        try:
+            code, health = _http_get_json(base + "/healthz")
+        except urllib.error.HTTPError as e:
+            code, health = e.code, {"status": f"http {e.code}"}
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"[watch] {base} unreachable: {e}", file=sys.stderr)
+            if args.once:
+                return 2
+            time.sleep(args.interval)
+            continue
+        try:
+            _, last = _http_get_json(base + "/last-round")
+        except Exception:  # noqa: BLE001 — health is the primary signal
+            last = {}
+        if code == 503:
+            if not stalled:
+                print_with_color(f"[watch] STALL detected: {health}", "red")
+            stalled = True
+        else:
+            stalled = False
+        rnd = last.get("round")
+        if last and rnd != seen_round:
+            seen_round = rnd
+            keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss")
+                    if isinstance(last.get(k), (int, float))]
+            msg = " ".join(f"{k}={last[k]:.4f}" for k in keys)
+            print(f"[watch] round {rnd} ok={last.get('ok')} "
+                  f"{msg}".rstrip(), flush=True)
+        if args.once:
+            return 1 if stalled else 0
+        time.sleep(args.interval)
 
 
 _SUBCOMMANDS = {
@@ -205,6 +299,7 @@ _SUBCOMMANDS = {
     "server": server_main,
     "client": client_main,
     "metrics": metrics_main,
+    "watch": watch_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -213,7 +308,9 @@ commands:
   run      run the federation in-process (attackers from config; telemetry on)
   server   rendezvous server (waits for `client` registrations)
   client   register one client (reference client.py parity)
-  metrics  summarize a run directory's events.jsonl (p50/p95, rounds/s)
+  metrics  summarize a run directory's events*.jsonl (p50/p95, rounds/s;
+           --merge: cross-host skew; --forensics: defense TPR/FPR)
+  watch    poll a live run's monitor endpoint (/last-round, /healthz)
 """
 
 

@@ -56,29 +56,77 @@ class HyperDetectionConfig:
     start_round: int = 18
 
 
+def parse_profile_rounds(spec: str) -> tuple[int, int] | None:
+    """Parse a ``--profile-rounds A:B`` window ("A" alone means A:A).
+    Returns (start, stop) inclusive 1-based round numbers, or None for the
+    empty spec.  Raises ValueError on malformed input."""
+    if not spec:
+        return None
+    start_text, sep, stop_text = spec.partition(":")
+    try:
+        start = int(start_text)
+        stop = int(stop_text) if sep else start
+    except ValueError:
+        raise ValueError(
+            f"profile_rounds must be 'A:B' (integers), got {spec!r}") from None
+    if not 1 <= start <= stop:
+        raise ValueError(
+            f"profile_rounds needs 1 <= A <= B, got {spec!r}")
+    return start, stop
+
+
 @dataclass(frozen=True)
 class TelemetryConfig:
     """Observability knobs (attackfl_tpu/telemetry): structured JSONL
-    events + Chrome-trace spans + counters.
+    events + Chrome-trace spans + counters + the live run monitor.
 
-    ``enabled`` gates ALL file output (events.jsonl / trace.json); off, the
-    engine uses null objects and pays no per-round I/O.  ``sample_every``
-    thins per-round event records for very long runs (failed rounds and the
-    compile round are always recorded).  Empty paths default to
-    ``<log_path>/events.jsonl`` and ``<log_path>/trace.json``; the
-    ``ATTACKFL_TELEMETRY_DIR`` env var (test harness) overrides the base
-    directory.
+    ``enabled`` gates ALL file output (events.jsonl / trace.json) AND the
+    monitor; off, the engine uses null objects and pays no per-round I/O.
+    ``sample_every`` thins per-round event records for very long runs
+    (failed rounds and the compile round are always recorded).  Empty paths
+    default to ``<log_path>/events.jsonl`` and ``<log_path>/trace.json``
+    (``events.<process_index>.jsonl`` / ``trace.<process_index>.json``
+    under a multi-host mesh); the ``ATTACKFL_TELEMETRY_DIR`` env var (test
+    harness) overrides the base directory.
+
+    ``monitor`` starts the live health endpoint + stall watchdog
+    (telemetry/monitor.py; process 0 only) on ``monitor_port`` (0 =
+    ephemeral; a busy fixed port falls back to ephemeral with a warning —
+    the actual URL is printed at run start).  The watchdog declares a stall when
+    no round completes within ``stall_factor ×`` the rolling-median round
+    time; before the FIRST round completes (compiles — and the round-5
+    init-wedge class) the threshold is ``stall_grace_seconds``.
+    ``profile_rounds`` ("A:B") wraps those rounds in
+    ``jax.profiler.start_trace/stop_trace`` writing device traces under
+    ``<telemetry base>/profile``.
     """
 
     enabled: bool = True
     sample_every: int = 1
     events_path: str = ""
     trace_path: str = ""
+    monitor: bool = False
+    monitor_port: int = 8780
+    stall_factor: float = 10.0
+    stall_grace_seconds: float = 900.0
+    profile_rounds: str = ""
 
     def __post_init__(self):
         if self.sample_every < 1:
             raise ValueError(
                 f"telemetry.sample_every must be >= 1, got {self.sample_every}")
+        if not 0 <= self.monitor_port <= 65535:
+            raise ValueError(
+                f"telemetry.monitor_port must be a port, got {self.monitor_port}")
+        if self.stall_factor <= 1.0:
+            raise ValueError(
+                "telemetry.stall_factor must be > 1 (a factor of the median "
+                f"round time), got {self.stall_factor}")
+        if self.stall_grace_seconds <= 0:
+            raise ValueError(
+                f"telemetry.stall_grace_seconds must be > 0, got "
+                f"{self.stall_grace_seconds}")
+        parse_profile_rounds(self.profile_rounds)  # validate format
 
 
 @dataclass(frozen=True)
@@ -405,6 +453,12 @@ def config_from_dict(raw: dict) -> Config:
             sample_every=int(_get(tele, "sample-every", 1)),
             events_path=str(_get(tele, "events-path", "")),
             trace_path=str(_get(tele, "trace-path", "")),
+            monitor=bool(_get(tele, "monitor", False)),
+            monitor_port=int(_get(tele, "monitor-port", 8780)),
+            stall_factor=float(_get(tele, "stall-factor", 10.0)),
+            stall_grace_seconds=float(
+                _get(tele, "stall-grace-seconds", 900.0)),
+            profile_rounds=str(_get(tele, "profile-rounds", "")),
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),

@@ -167,13 +167,11 @@ def krum(stacked: Any, f: int = 0, mask: jnp.ndarray | None = None) -> Any:
     return pt.tree_take(stacked, krum_select(stacked, f, mask))
 
 
-def shieldfl(stacked: Any, eps: float = 1e-6,
-             mask: jnp.ndarray | None = None) -> Any:
-    """ShieldFL-style cosine-deviation weighting (reference inline code,
-    server.py:306-350): normalize flat client vectors, reference = their
-    mean, weight_i ∝ 1/(1 − cos_i + ε), weighted average of raw params.
-    With ``mask``, dropped clients are excluded from the reference
-    direction and zero-weighted in the average."""
+def shieldfl_weights(stacked: Any, eps: float = 1e-6,
+                     mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """ShieldFL's per-client weights (the defense's actual decision,
+    exposed for forensic attribution): normalize flat client vectors,
+    reference = their mean, weight_i ∝ 1/(1 − cos_i + ε)."""
     flat = pt.tree_ravel_stacked(stacked)
     unit = flat / (jnp.linalg.norm(flat, axis=1, keepdims=True) + 1e-8)
     if mask is None:
@@ -185,7 +183,40 @@ def shieldfl(stacked: Any, eps: float = 1e-6,
     weights = 1.0 / (1.0 - cos + eps)
     if mask is not None:
         weights = weights * mask
-    return pt.tree_weighted_mean(stacked, weights)
+    return weights
+
+
+def shieldfl(stacked: Any, eps: float = 1e-6,
+             mask: jnp.ndarray | None = None) -> Any:
+    """ShieldFL-style cosine-deviation weighting (reference inline code,
+    server.py:306-350): weighted average of raw params under
+    :func:`shieldfl_weights`.  With ``mask``, dropped clients are excluded
+    from the reference direction and zero-weighted in the average."""
+    return pt.tree_weighted_mean(stacked, shieldfl_weights(stacked, eps, mask))
+
+
+def byzantine_keep(stacked: Any, threshold: float = 0.9,
+                   mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """The byzantine-tolerance keep weights (exposed for forensic
+    attribution): cosine-vs-anchor filter with the reference's
+    fall-back-to-everyone semantics (see :func:`byzantine_tolerance`)."""
+    flat = pt.tree_ravel_stacked(stacked)  # (N, P)
+    if mask is None:
+        maskf = jnp.ones((flat.shape[0],), flat.dtype)
+    else:
+        maskf = mask.astype(flat.dtype)
+    anchor = flat[jnp.argmax(maskf)]  # first valid client (0 when unmasked)
+    cos = (flat @ anchor) / (
+        jnp.linalg.norm(flat, axis=1) * jnp.linalg.norm(anchor) + 1e-12)
+    keep = (cos >= threshold).astype(flat.dtype) * maskf
+    keep = jnp.where(jnp.sum(keep) > 0, keep, maskf)
+    # degenerate all-zero participation mask (every client dropped): the
+    # maskf fallback is itself all-zero and tree_weighted_mean would
+    # divide by sum(weights)=0 → NaN params (ADVICE.md finding 1).  Fall
+    # back to an unweighted mean; callers fail such rounds upstream, but
+    # the fused scan body evaluates the aggregate unconditionally and must
+    # not see NaNs it didn't create.
+    return jnp.where(jnp.sum(maskf) > 0, keep, jnp.ones_like(maskf))
 
 
 def byzantine_tolerance(stacked: Any, threshold: float = 0.9,
@@ -206,24 +237,8 @@ def byzantine_tolerance(stacked: Any, threshold: float = 0.9,
     the first valid row) and are zero-weighted; the fallback is to all
     *valid* clients.  Soft-mask weighting keeps shapes static.
     """
-    flat = pt.tree_ravel_stacked(stacked)  # (N, P)
-    if mask is None:
-        maskf = jnp.ones((flat.shape[0],), flat.dtype)
-    else:
-        maskf = mask.astype(flat.dtype)
-    anchor = flat[jnp.argmax(maskf)]  # first valid client (0 when unmasked)
-    cos = (flat @ anchor) / (
-        jnp.linalg.norm(flat, axis=1) * jnp.linalg.norm(anchor) + 1e-12)
-    keep = (cos >= threshold).astype(flat.dtype) * maskf
-    keep = jnp.where(jnp.sum(keep) > 0, keep, maskf)
-    # degenerate all-zero participation mask (every client dropped): the
-    # maskf fallback is itself all-zero and tree_weighted_mean would
-    # divide by sum(weights)=0 → NaN params (ADVICE.md finding 1).  Fall
-    # back to an unweighted mean; callers fail such rounds upstream, but
-    # the fused scan body evaluates the aggregate unconditionally and must
-    # not see NaNs it didn't create.
-    keep = jnp.where(jnp.sum(maskf) > 0, keep, jnp.ones_like(maskf))
-    return pt.tree_weighted_mean(stacked, keep)
+    return pt.tree_weighted_mean(stacked,
+                                 byzantine_keep(stacked, threshold, mask))
 
 
 # ---------------------------------------------------------------------------
@@ -251,24 +266,16 @@ def dequantize(sigma: jnp.ndarray, smin, smax) -> jnp.ndarray:
     return smin + sigma * (smax - smin)
 
 
-def scionfl(
+def scionfl_weights(
     stacked: Any,
     sizes: jnp.ndarray,
     rng: jax.Array,
     mu_threshold: float = 3.0,
     topk_ratio: float = 0.5,
-) -> Any:
-    """ScionFL aggregation (reference: server.py:436-492).
-
-    1. per-client stochastic 1-bit quantization of the flat update;
-    2. L2-norm clipping at mu_threshold × mean norm (scales smin/smax);
-    3. dequantize + mean -> aggregate direction;
-    4. cosine-distance filtering: keep clients with distance ABOVE the
-       (1−topk)-quantile — the reference keeps the *most dissimilar* half
-       (``s > threshold``, server.py:466); replicated verbatim;
-    5. size-weighted FedAvg of the survivors (soft mask: excluded clients
-       get zero weight so shapes stay static).
-    """
+) -> jnp.ndarray:
+    """ScionFL's per-client aggregation weights (the decision, exposed for
+    forensic attribution — same ``rng`` reproduces the same stochastic
+    quantization and therefore the same filter as the aggregate)."""
     flat = pt.tree_ravel_stacked(stacked)  # (N, P)
     n = flat.shape[0]
     keys = jax.random.split(rng, n)
@@ -290,13 +297,48 @@ def scionfl(
 
     weights = jnp.where(benign, sizes.astype(jnp.float32), 0.0)
     # fall back to all clients if the filter empties (degenerate ties)
-    weights = jnp.where(jnp.sum(weights) > 0, weights, sizes.astype(jnp.float32))
-    return pt.tree_weighted_mean(stacked, weights)
+    return jnp.where(jnp.sum(weights) > 0, weights,
+                     sizes.astype(jnp.float32))
+
+
+def scionfl(
+    stacked: Any,
+    sizes: jnp.ndarray,
+    rng: jax.Array,
+    mu_threshold: float = 3.0,
+    topk_ratio: float = 0.5,
+) -> Any:
+    """ScionFL aggregation (reference: server.py:436-492).
+
+    1. per-client stochastic 1-bit quantization of the flat update;
+    2. L2-norm clipping at mu_threshold × mean norm (scales smin/smax);
+    3. dequantize + mean -> aggregate direction;
+    4. cosine-distance filtering: keep clients with distance ABOVE the
+       (1−topk)-quantile — the reference keeps the *most dissimilar* half
+       (``s > threshold``, server.py:466); replicated verbatim;
+    5. size-weighted FedAvg of the survivors (soft mask: excluded clients
+       get zero weight so shapes stay static).
+    """
+    return pt.tree_weighted_mean(
+        stacked,
+        scionfl_weights(stacked, sizes, rng, mu_threshold, topk_ratio))
 
 
 # ---------------------------------------------------------------------------
 # FLTrust combine (root training lives in training/fltrust.py)
 # ---------------------------------------------------------------------------
+
+def fltrust_trust(client_deltas: Any, root_delta: Any) -> jnp.ndarray:
+    """FLTrust's per-client trust scores trust_i = ReLU(cos(Δ_i, Δ_root))
+    (exposed for forensic attribution: trust 0 means the client's update
+    contributed nothing to the aggregate — the defense removed it)."""
+    flat_deltas = pt.tree_ravel_stacked(client_deltas)  # (N, P)
+    flat_root = pt.tree_ravel(root_delta)  # (P,)
+    norms = jnp.linalg.norm(flat_deltas, axis=1)
+    cos = (flat_deltas @ flat_root) / (
+        norms * jnp.linalg.norm(flat_root) + 1e-12)
+    return jnp.maximum(cos, 0.0)
+
 
 def fltrust_combine(global_params: Any, client_deltas: Any, root_delta: Any) -> Any:
     """Trust-weighted combination (reference: train_FLTrust,

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 #: Platform names that mean "TPU silicon" — the single place to update on
 #: the next plugin rename (consumed by is_tpu_backend, the out-of-process
-#: probe checks in scripts/measure_baseline.py + scripts/tpu_watch.sh, and
+#: probe check in scripts/measure_baseline.py, and
 #: cli.py's --device tpu resolution).  Ordered most-specific first: the
 #: stock "tpu" factory is registered even on machines with no TPU, so
 #: resolution-by-registered-factory must try the plugin names before it.
@@ -177,6 +177,15 @@ def broadcast_bytes(data: bytes | None) -> bytes | None:
              if data is not None and len(data) == n
              else np.zeros(n, np.uint8))
     return multihost_utils.broadcast_one_to_all(local).tobytes()
+
+
+def broadcast_string(text: str | None) -> str | None:
+    """Broadcast process 0's UTF-8 string to all processes (None passes
+    through).  Used to share one telemetry ``run_id`` across a DCN mesh so
+    the per-process ``events.<i>.jsonl`` files can be correlated by
+    ``metrics --merge``."""
+    data = broadcast_bytes(text.encode("utf-8") if text is not None else None)
+    return data.decode("utf-8") if data is not None else None
 
 
 def make_client_mesh(num_devices: int = 0, axis_name: str = "clients") -> Mesh:

@@ -1,17 +1,26 @@
 """First-class observability for the federation engine.
 
-Pieces (ISSUE 1 tentpole):
+Pieces (ISSUE 1 tentpole + the ISSUE 2 distributed monitoring layer):
 
-* :class:`EventLog` — structured JSONL records (``events.jsonl``): run
-  header, per-round phase durations + metrics + attack/defense decisions,
-  compile/chunk records, retry/rollback/checkpoint lifecycle, counters.
+* :class:`EventLog` — structured JSONL records (``events.jsonl``; one
+  ``events.<process_index>.jsonl`` per process under a DCN mesh, keyed by
+  the shared run_id): run header, per-round phase durations + metrics +
+  attack/defense decisions, compile/chunk records,
+  retry/rollback/checkpoint/stall/attribution lifecycle, counters.
 * :class:`Tracer` — nested host-side spans serialized in Chrome
   trace-event format (``trace.json``; open in https://ui.perfetto.dev).
 * :class:`Counters` — monotonic health counters (rounds retried, NaN
-  clients, anomalies removed, checkpoint writes, program-cache hits).
+  clients, anomalies removed, checkpoint writes, program-cache hits,
+  stalls detected).
+* :class:`RunMonitor` — live health endpoint (``/healthz``, ``/metrics``,
+  ``/last-round``) + stall watchdog (:mod:`~attackfl_tpu.telemetry.monitor`).
 * :mod:`~attackfl_tpu.telemetry.summary` — the ``attackfl-tpu metrics``
   CLI turning ``events.jsonl`` into per-phase p50/p95 and rounds/s
   (steady vs incl-compile) tables.
+* :mod:`~attackfl_tpu.telemetry.merge` — ``metrics --merge``: interleave
+  per-process event files and report cross-host round skew.
+* :mod:`~attackfl_tpu.telemetry.forensics` — ``metrics --forensics``:
+  defense TPR/FPR from per-round attribution events.
 
 Everything records host-side values only — no callbacks ever enter traced
 code, so telemetry is zero-cost inside jitted programs and a null-object
@@ -31,6 +40,7 @@ from attackfl_tpu.telemetry.events import (  # noqa: F401
     metric_line,
     validate_event,
 )
+from attackfl_tpu.telemetry.monitor import RunMonitor  # noqa: F401
 from attackfl_tpu.telemetry.timing import RoundTimer  # noqa: F401
 from attackfl_tpu.telemetry.trace import NullTracer, Tracer  # noqa: F401
 from attackfl_tpu.telemetry.xla import memory_analysis_bytes  # noqa: F401
@@ -42,6 +52,7 @@ __all__ = [
     "NullEventLog",
     "NullTracer",
     "RoundTimer",
+    "RunMonitor",
     "SCHEMA_VERSION",
     "Telemetry",
     "Tracer",

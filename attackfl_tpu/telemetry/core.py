@@ -25,29 +25,53 @@ ENV_DIR = "ATTACKFL_TELEMETRY_DIR"
 
 
 class Telemetry:
-    def __init__(self, events, tracer, counters: Counters, enabled: bool):
+    def __init__(self, events, tracer, counters: Counters, enabled: bool,
+                 base_dir: str | None = None):
         self.events = events
         self.tracer = tracer
         self.counters = counters
         self.enabled = enabled
+        # output base (profile traces land under <base_dir>/profile)
+        self.base_dir = base_dir
 
     @classmethod
     def disabled(cls) -> "Telemetry":
         return cls(NullEventLog(), NullTracer(), Counters(), False)
 
     @classmethod
-    def from_config(cls, cfg: Any) -> "Telemetry":
+    def from_config(cls, cfg: Any, process_index: int | None = None,
+                    run_id: str | None = None) -> "Telemetry":
+        """Build the facade.  ``process_index`` (a multi-host run) routes
+        output to per-process files — ``events.<i>.jsonl`` /
+        ``trace.<i>.json`` by default, or the explicit config paths with a
+        ``.<i>`` suffix spliced in before the extension so N processes on a
+        shared filesystem never clobber one file.  ``run_id`` is the shared
+        id broadcast from process 0 (engine.py)."""
         tcfg = getattr(cfg, "telemetry", None)
         if tcfg is None or not getattr(tcfg, "enabled", False):
             return cls.disabled()
         base = os.environ.get(ENV_DIR) or getattr(cfg, "log_path", ".") or "."
-        events_path = tcfg.events_path or os.path.join(base, "events.jsonl")
-        trace_path = tcfg.trace_path or os.path.join(base, "trace.json")
+        if process_index is None:
+            events_default, trace_default = "events.jsonl", "trace.json"
+        else:
+            events_default = f"events.{process_index}.jsonl"
+            trace_default = f"trace.{process_index}.json"
+        events_path = tcfg.events_path or os.path.join(base, events_default)
+        trace_path = tcfg.trace_path or os.path.join(base, trace_default)
+        if process_index is not None:
+            if tcfg.events_path:
+                root, ext = os.path.splitext(tcfg.events_path)
+                events_path = f"{root}.{process_index}{ext}"
+            if tcfg.trace_path:
+                root, ext = os.path.splitext(tcfg.trace_path)
+                trace_path = f"{root}.{process_index}{ext}"
         return cls(
-            EventLog(events_path, sample_every=tcfg.sample_every),
+            EventLog(events_path, sample_every=tcfg.sample_every,
+                     run_id=run_id, process_index=process_index),
             Tracer(trace_path),
             Counters(),
             True,
+            base_dir=base,
         )
 
     def flush(self) -> None:

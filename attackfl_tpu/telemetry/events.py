@@ -11,6 +11,20 @@ and ``attackfl_tpu.telemetry.summary`` turns the file back into the
 per-phase p50/p95 and rounds/s numbers previously hand-extracted into
 bench artifacts like ``FULL_PARITY_JAX_STEADY.json``.
 
+Schema v2 (ISSUE 2) extends v1 — every v1 file still validates:
+
+* an optional ``process_index`` envelope field: under a multi-host (DCN)
+  mesh every process writes its own ``events.<process_index>.jsonl`` keyed
+  by the SHARED ``run_id`` (broadcast from process 0), and
+  ``attackfl_tpu.telemetry.merge`` interleaves them by ``ts`` for
+  cross-host round-skew analysis (``attackfl-tpu metrics --merge``);
+* ``stall`` — the watchdog's hung-run detection
+  (:mod:`~attackfl_tpu.telemetry.monitor`);
+* ``attribution`` — per-round defense forensics: ground-truth attacker set
+  vs. the defense's kept/removed decision
+  (:mod:`~attackfl_tpu.telemetry.forensics`);
+* ``profile`` — ``--profile-rounds`` device-trace window markers.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.
@@ -20,11 +34,12 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -44,9 +59,21 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     "run_end": {"rounds": int, "ok_rounds": int, "seconds": _NUM},
     # bench.py's one-line metric contract, emitted through the same schema
     "metric": {"metric": str, "value": _NUM, "unit": str},
+    # --- schema v2 kinds ---
+    # watchdog: no round completed within the stall threshold
+    "stall": {"seconds_since_round": _NUM, "threshold_seconds": _NUM,
+              "rounds_completed": int},
+    # defense forensics: ground truth vs. the defense's per-round decision
+    "attribution": {"round": int, "mode": str, "attackers": list,
+                    "kept": list, "removed": list},
+    # jax.profiler --profile-rounds window markers
+    "profile": {"action": str},
 }
 
 _COMMON_FIELDS: dict[str, Any] = {"schema": int, "kind": str, "ts": _NUM}
+# Envelope fields that MAY appear (schema v2) and are type-checked when
+# present; absent is always valid (v1 files carry neither).
+_OPTIONAL_COMMON_FIELDS: dict[str, Any] = {"process_index": int}
 
 
 def _jsonable(value: Any) -> Any:
@@ -88,6 +115,11 @@ def validate_event(record: Any) -> list[str]:
         elif not isinstance(record[name], typ):
             errors.append(
                 f"field '{name}' has type {type(record[name]).__name__}")
+    for name, typ in _OPTIONAL_COMMON_FIELDS.items():
+        if name in record and (isinstance(record[name], bool)
+                               or not isinstance(record[name], typ)):
+            errors.append(f"field '{name}' must be {typ.__name__}, got "
+                          f"{type(record[name]).__name__}")
     kind = record.get("kind")
     if isinstance(kind, str):
         required = REQUIRED_FIELDS.get(kind)
@@ -135,16 +167,26 @@ def metric_line(metric: str, value: float, unit: str = "rounds/s",
 
 class EventLog:
     """Append-only JSONL writer for one run (line-buffered, so partial
-    runs — the round-5 wedge scenario — still leave a usable record)."""
+    runs — the round-5 wedge scenario — still leave a usable record).
+
+    ``process_index``, when given (a multi-host run), is stamped into every
+    record's envelope; ``run_id`` is then the SHARED id broadcast from
+    process 0 so ``metrics --merge`` can correlate the per-process files.
+    Writes are lock-serialized: the stall watchdog emits from its own
+    thread while the round loop owns the main thread.
+    """
 
     enabled = True
 
     def __init__(self, path: str, sample_every: int = 1,
-                 run_id: str | None = None):
+                 run_id: str | None = None,
+                 process_index: int | None = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.sample_every = max(int(sample_every), 1)
         self.run_id = run_id or uuid.uuid4().hex[:12]
+        self.process_index = process_index
+        self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1)
 
     def emit(self, kind: str, **fields: Any) -> dict[str, Any]:
@@ -154,9 +196,12 @@ class EventLog:
             "ts": round(time.time(), 6),
             "run_id": self.run_id,
         }
+        if self.process_index is not None:
+            record["process_index"] = int(self.process_index)
         for key, value in fields.items():
             record[key] = _jsonable(value)
-        self._fh.write(json.dumps(record) + "\n")
+        with self._lock:
+            self._fh.write(json.dumps(record) + "\n")
         return record
 
     def round_event(self, metrics: dict[str, Any]) -> None:
@@ -186,6 +231,7 @@ class NullEventLog:
     path = None
     run_id = "disabled"
     sample_every = 1
+    process_index = None
 
     def emit(self, kind: str, **fields: Any) -> dict[str, Any]:
         return {}

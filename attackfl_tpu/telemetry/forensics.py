@@ -1,0 +1,128 @@
+"""Defense forensics: TPR/FPR of a defense from ``attribution`` events.
+
+The paper's experimental question is "does defense D remove attack A's
+clients?" — until ISSUE 2 that was not measurable from run artifacts.  Now
+every per-round-path round with attackers configured emits an
+``attribution`` event (engine.py) recording the ground-truth set of
+clients that actually attacked this broadcast vs. the defense's
+kept/removed decision (krum selection, trimmed-mean/median survival
+fractions, ShieldFL/FLTrust/ScionFL weights, GMM/FLTracer host filters —
+see ``training/round.py:build_attribution_fn``).  This module turns those
+events back into per-run detection quality:
+
+* **TPR** (recall) = removed attackers / attackers present,
+* **FPR** = removed honest clients / honest clients present,
+* **precision** = removed attackers / all removed,
+
+micro-averaged over rounds (sum the confusion counts, then divide), plus
+the per-round rows for drill-down.  ``attackfl-tpu metrics --forensics``
+is the CLI surface.  Deliberately jax-free, like the rest of the metrics
+tooling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def confusion_counts(attackers: list[int], kept: list[int],
+                     removed: list[int]) -> dict[str, int]:
+    """One round's confusion matrix.  "Positive" = the defense removed the
+    client; ground truth = the client attacked this round.  Clients absent
+    from both ``kept`` and ``removed`` (non-reporting) are excluded."""
+    attacker_set = set(attackers)
+    removed_set = set(removed)
+    kept_set = set(kept)
+    return {
+        "tp": len(removed_set & attacker_set),
+        "fp": len(removed_set - attacker_set),
+        "fn": len(kept_set & attacker_set),
+        "tn": len(kept_set - attacker_set),
+    }
+
+
+def rates(tp: int, fp: int, fn: int, tn: int) -> dict[str, float | None]:
+    """Detection-quality rates; None when the denominator is empty (e.g.
+    FPR of a round with no honest clients present)."""
+    return {
+        "tpr": round(tp / (tp + fn), 6) if (tp + fn) else None,
+        "fpr": round(fp / (fp + tn), 6) if (fp + tn) else None,
+        "precision": round(tp / (tp + fp), 6) if (tp + fp) else None,
+    }
+
+
+def forensics_summary(events: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Aggregate one run's ``attribution`` events.
+
+    Multi-process merged streams carry one attribution event per process
+    for the same broadcast (the computation is SPMD-identical); those are
+    deduplicated keeping the first occurrence.  Retried rounds keep one
+    verdict per broadcast — each broadcast is a distinct defense decision.
+    Returns None when the run recorded no attribution events (no attackers
+    configured, fused path, or a pre-v2 artifact).
+    """
+    seen: set[tuple[Any, Any, Any]] = set()
+    per_round: list[dict[str, Any]] = []
+    totals = {"tp": 0, "fp": 0, "fn": 0, "tn": 0}
+    mode = None
+    attack_rounds = 0
+    for event in events:
+        if event.get("kind") != "attribution":
+            continue
+        key = (event.get("run_id"), event.get("round"),
+               event.get("broadcast"))
+        if key in seen:
+            continue
+        seen.add(key)
+        mode = event.get("mode", mode)
+        counts = confusion_counts(event.get("attackers") or [],
+                                  event.get("kept") or [],
+                                  event.get("removed") or [])
+        for name in totals:
+            totals[name] += counts[name]
+        if event.get("attackers"):
+            attack_rounds += 1
+        per_round.append({
+            "round": event.get("round"),
+            "attackers": len(event.get("attackers") or []),
+            "removed": len(event.get("removed") or []),
+            **counts,
+            **rates(**counts),
+        })
+    if not per_round:
+        return None
+    return {
+        "mode": mode,
+        "rounds": len(per_round),
+        "attack_rounds": attack_rounds,
+        **totals,
+        **rates(**totals),
+        "per_round": per_round,
+    }
+
+
+def format_forensics(summary: dict[str, Any],
+                     run_id: str | None = None) -> str:
+    def fmt(value: float | None) -> str:
+        return "n/a" if value is None else f"{value:.4f}"
+
+    lines = [
+        f"defense forensics — mode={summary['mode']}"
+        + (f" run {run_id}" if run_id else ""),
+        f"rounds with attribution: {summary['rounds']} "
+        f"({summary['attack_rounds']} under active attack)",
+        f"confusion (micro): tp={summary['tp']} fp={summary['fp']} "
+        f"fn={summary['fn']} tn={summary['tn']}",
+        f"TPR={fmt(summary['tpr'])} FPR={fmt(summary['fpr'])} "
+        f"precision={fmt(summary['precision'])}",
+    ]
+    flagged = [r for r in summary["per_round"] if r["attackers"]]
+    if flagged:
+        lines.append(f"{'round':<8}{'attackers':>10}{'removed':>9}"
+                     f"{'tp':>5}{'fp':>5}{'TPR':>8}{'FPR':>8}")
+        for row in flagged:
+            lines.append(
+                f"{row['round']:<8}{row['attackers']:>10}{row['removed']:>9}"
+                f"{row['tp']:>5}{row['fp']:>5}"
+                f"{fmt(row['tpr']):>8}{fmt(row['fpr']):>8}")
+    return "\n".join(lines)

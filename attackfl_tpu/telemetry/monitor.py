@@ -1,0 +1,294 @@
+"""Live run monitor: health endpoint + stall watchdog (ISSUE 2 tentpole).
+
+The round-5 TPU init wedge (VERDICT.md) exposed the observability gap this
+module closes: a hung run looked identical to a slow one until someone
+grepped logs.  :class:`RunMonitor` runs a stdlib ``http.server`` thread
+(config-gated, process 0 only — engine.py wiring) serving
+
+* ``/healthz`` — 200 while rounds keep completing, 503 once the watchdog
+  declares a stall (JSON body with the evidence either way);
+* ``/metrics`` — Prometheus text format: the Counters registry, rounds
+  completed, last-round phase durations, the rolling-median round time and
+  the current stall threshold;
+* ``/last-round`` — the most recent round record as JSON (what
+  ``attackfl-tpu watch`` polls).
+
+The **stall watchdog** is a daemon thread that flags the run when no round
+completes within ``stall_factor ×`` the rolling-median round duration
+(floored at ``MIN_STALL_SECONDS``; before the FIRST round completes —
+where compiles live, and where the round-5 wedge actually hung — the
+threshold is ``stall_grace_seconds``).  On the healthy→stalled transition
+it emits one ``stall`` event into the run's event log (EventLog.emit is
+lock-serialized for exactly this cross-thread write) and bumps the
+``stalls_detected`` counter; the next completed round clears the state.
+
+Everything here is observational: the monitor never touches simulation
+state, and with ``telemetry.enabled: false`` it is never constructed.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+# Absolute floor for the stall threshold: with sub-second rounds a single
+# GC pause or checkpoint fsync must not trip the watchdog.
+MIN_STALL_SECONDS = 5.0
+
+
+def _sanitize(name: str) -> str:
+    """Counter name -> Prometheus metric-name charset."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+class RunMonitor:
+    """Health server + stall watchdog for one Simulator process.
+
+    ``record_round`` is the heartbeat: the engine calls it after every
+    completed round attempt (per-round path) or once per fused chunk with
+    the amortized per-round duration (the chunk is one device dispatch, so
+    per-round wall time inside it is not observable — the watchdog needs a
+    cadence estimate, not a measurement).
+    """
+
+    def __init__(self, telemetry, port: int = 0, host: str = "0.0.0.0",
+                 stall_factor: float = 10.0,
+                 stall_grace_seconds: float = 900.0,
+                 poll_interval: float = 1.0, history: int = 64):
+        self._tel = telemetry
+        self._requested_port = int(port)
+        self._host = host
+        self.stall_factor = float(stall_factor)
+        self.stall_grace_seconds = float(stall_grace_seconds)
+        self.poll_interval = float(poll_interval)
+        self._lock = threading.Lock()
+        self._durations: deque[float] = deque(maxlen=history)
+        self._last_round: dict[str, Any] | None = None
+        self._last_beat: float | None = None  # monotonic; set by start()
+        self._rounds_completed = 0
+        self._active = False  # watchdog only arms between run start/end
+        self._stalled = False
+        self._stall_info: dict[str, Any] = {}
+        self._server: ThreadingHTTPServer | None = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.port: int | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "RunMonitor":
+        """Bind the health server (idempotent) and start the watchdog."""
+        if self._server is not None:
+            return self
+        monitor = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+            def do_GET(self):
+                monitor._handle(self)
+
+        try:
+            self._server = ThreadingHTTPServer(
+                (self._host, self._requested_port), Handler)
+        except OSError:
+            # fixed port taken (another run's monitor?) — an observability
+            # thread must never kill the run it observes; fall back to an
+            # ephemeral port, reported via self.port / the startup banner
+            self._server = ThreadingHTTPServer((self._host, 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        serve = threading.Thread(target=self._server.serve_forever,
+                                 name="attackfl-monitor-http", daemon=True)
+        watchdog = threading.Thread(target=self._watchdog_loop,
+                                    name="attackfl-monitor-watchdog",
+                                    daemon=True)
+        self._threads = [serve, watchdog]
+        serve.start()
+        watchdog.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def run_started(self) -> None:
+        """Arm the watchdog; the grace window starts counting now."""
+        with self._lock:
+            self._active = True
+            self._stalled = False
+            self._last_beat = time.monotonic()
+
+    def run_ended(self) -> None:
+        """Disarm the watchdog (a finished run is not a stalled one)."""
+        with self._lock:
+            self._active = False
+            self._stalled = False
+
+    # ------------------------------------------------------------------
+    # heartbeat + stall detection
+    # ------------------------------------------------------------------
+
+    def record_round(self, metrics: dict[str, Any],
+                     duration: float | None = None) -> None:
+        """One completed round attempt.  ``duration`` overrides
+        ``metrics["seconds"]`` (fused chunks pass elapsed/chunk_len)."""
+        if duration is None:
+            seconds = metrics.get("seconds")
+            duration = float(seconds) if isinstance(seconds, (int, float)) \
+                else None
+        with self._lock:
+            if duration is not None and duration > 0:
+                self._durations.append(float(duration))
+            self._last_round = {k: v for k, v in metrics.items()
+                                if _is_plain(v)}
+            self._last_beat = time.monotonic()
+            self._rounds_completed += 1
+            self._stalled = False
+            self._stall_info = {}
+
+    def stall_threshold_seconds(self) -> float:
+        """Current stall threshold: stall_factor × rolling-median round
+        time (floored), or the grace window before any round completed."""
+        with self._lock:
+            durations = list(self._durations)
+        if not durations:
+            return max(self.stall_grace_seconds, MIN_STALL_SECONDS)
+        return max(self.stall_factor * statistics.median(durations),
+                   MIN_STALL_SECONDS)
+
+    def check_stall(self, now: float | None = None) -> bool:
+        """One watchdog tick.  ``now`` (monotonic seconds) is injectable so
+        tests can simulate a hang without sleeping.  Emits the ``stall``
+        event exactly once per healthy→stalled transition."""
+        now = time.monotonic() if now is None else now
+        threshold = self.stall_threshold_seconds()
+        with self._lock:
+            if not self._active or self._last_beat is None:
+                return False
+            since = now - self._last_beat
+            if since <= threshold:
+                return self._stalled
+            transition = not self._stalled
+            self._stalled = True
+            self._stall_info = {
+                "seconds_since_round": round(since, 3),
+                "threshold_seconds": round(threshold, 3),
+                "rounds_completed": self._rounds_completed,
+            }
+            info = dict(self._stall_info)
+        if transition:
+            self._tel.counters.inc("stalls_detected")
+            self._tel.events.emit("stall", **info)
+            self._tel.events.flush()
+        return True
+
+    def _watchdog_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.check_stall()
+            except Exception:  # noqa: BLE001 — the watchdog must not die
+                pass
+
+    # ------------------------------------------------------------------
+    # endpoint payloads
+    # ------------------------------------------------------------------
+
+    def health(self) -> tuple[int, dict[str, Any]]:
+        with self._lock:
+            if self._stalled:
+                return 503, {"status": "stalled", **self._stall_info}
+            return 200, {
+                "status": "ok",
+                "active": self._active,
+                "rounds_completed": self._rounds_completed,
+            }
+
+    def last_round(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._last_round or {})
+
+    def metrics_text(self) -> str:
+        """The Counters registry + round/stall gauges in Prometheus text
+        exposition format."""
+        with self._lock:
+            durations = list(self._durations)
+            last = dict(self._last_round or {})
+            rounds = self._rounds_completed
+            stalled = int(self._stalled)
+        lines = [
+            "# TYPE attackfl_rounds_completed counter",
+            f"attackfl_rounds_completed {rounds}",
+            "# TYPE attackfl_stalled gauge",
+            f"attackfl_stalled {stalled}",
+            "# TYPE attackfl_stall_threshold_seconds gauge",
+            f"attackfl_stall_threshold_seconds "
+            f"{self.stall_threshold_seconds():.6f}",
+        ]
+        if durations:
+            lines += [
+                "# TYPE attackfl_round_seconds_median gauge",
+                f"attackfl_round_seconds_median "
+                f"{statistics.median(durations):.6f}",
+            ]
+        phases = last.get("phases")
+        if isinstance(phases, dict):
+            lines.append("# TYPE attackfl_last_round_phase_seconds gauge")
+            for phase, dur in phases.items():
+                if isinstance(dur, (int, float)):
+                    lines.append(
+                        f'attackfl_last_round_phase_seconds'
+                        f'{{phase="{_sanitize(str(phase))}"}} {dur:.6f}')
+        counters = self._tel.counters.snapshot()
+        if counters:
+            lines.append("# TYPE attackfl_counter counter")
+            for name, value in counters.items():
+                lines.append(
+                    f'attackfl_counter{{name="{_sanitize(name)}"}} {value}')
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------
+    # http plumbing
+    # ------------------------------------------------------------------
+
+    def _handle(self, request: BaseHTTPRequestHandler) -> None:
+        path = request.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            code, payload = self.health()
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        elif path == "/metrics":
+            code, body, ctype = 200, self.metrics_text().encode(), \
+                "text/plain; version=0.0.4"
+        elif path == "/last-round":
+            code, body, ctype = 200, json.dumps(self.last_round()).encode(), \
+                "application/json"
+        else:
+            code, body, ctype = 404, b'{"error": "unknown path"}', \
+                "application/json"
+        request.send_response(code)
+        request.send_header("Content-Type", ctype)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)
+
+
+def _is_plain(value: Any) -> bool:
+    """JSON-clean check for /last-round payloads (round metrics are already
+    host values, but be defensive about stray arrays)."""
+    try:
+        json.dumps(value)
+        return True
+    except (TypeError, ValueError):
+        return False

@@ -24,10 +24,15 @@ FINAL_METRIC_KEYS = ("roc_auc", "accuracy", "nll", "train_loss")
 def load_events(path: str) -> list[dict[str, Any]]:
     """Read events from a file, or from ``<path>/events.jsonl`` when given
     a directory.  Malformed lines are skipped (a wedged run can die
-    mid-write) but counted into the '_skipped' sentinel of the result."""
+    mid-write) but counted into the '_skipped' sentinel of the result: a
+    synthetic trailing ``{"kind": "_skipped", "count": N, "path": ...}``
+    record (in-memory only, never written to disk) that ``summarize``
+    surfaces as ``skipped_lines`` so a truncated artifact is visibly
+    truncated instead of silently shorter."""
     if os.path.isdir(path):
         path = os.path.join(path, "events.jsonl")
     events: list[dict[str, Any]] = []
+    skipped = 0
     with open(path) as fh:
         for line in fh:
             line = line.strip()
@@ -36,9 +41,14 @@ def load_events(path: str) -> list[dict[str, Any]]:
             try:
                 record = json.loads(line)
             except json.JSONDecodeError:
+                skipped += 1
                 continue
             if isinstance(record, dict):
                 events.append(record)
+            else:
+                skipped += 1  # valid JSON but not an event object
+    if skipped:
+        events.append({"kind": "_skipped", "count": skipped, "path": path})
     return events
 
 
@@ -78,6 +88,8 @@ def percentile(values: list[float], q: float) -> float:
 def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
     """Aggregate one run's events into the summary dict the CLI renders."""
     header = next((e for e in events if e.get("kind") == "run_header"), None)
+    skipped = sum(e.get("count", 0) for e in events
+                  if e.get("kind") == "_skipped")
     rounds = [e for e in events if e.get("kind") == "round"]
     chunks = [e for e in events if e.get("kind") == "chunk"]
     compiles = [e for e in events if e.get("kind") == "compile"]
@@ -160,6 +172,7 @@ def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
         "counters": counters,
         "run_end": ({k: run_end.get(k) for k in ("rounds", "ok_rounds", "seconds")}
                     if run_end else None),
+        "skipped_lines": skipped,
     }
 
 
@@ -204,14 +217,86 @@ def format_summary(summary: dict[str, Any]) -> str:
         lines.append(f"run_end: {summary['run_end']['ok_rounds']}/"
                      f"{summary['run_end']['rounds']} ok in "
                      f"{summary['run_end']['seconds']:.2f}s")
+    if summary.get("skipped_lines"):
+        lines.append(f"skipped: {summary['skipped_lines']} malformed "
+                     "line(s) (truncated mid-write?)")
     return "\n".join(lines)
+
+
+def _select_runs(events: list[dict[str, Any]], run_id: str | None,
+                 all_runs: bool) -> list[list[dict[str, Any]]]:
+    """The CLI's run-selection rule: a specific --run-id, --all, or the
+    last run recorded in the file."""
+    runs = split_runs(events)
+    if run_id:
+        runs = [r for r in runs if any(e.get("run_id") == run_id for e in r)]
+    elif not all_runs:
+        runs = runs[-1:]
+    return runs
+
+
+def _merge_main(args) -> int:
+    from attackfl_tpu.telemetry import merge as merge_mod
+
+    try:
+        merged, per_process = merge_mod.merge_events(args.path)
+    except (FileNotFoundError, NotADirectoryError):
+        merged, per_process = [], {}
+    if not merged:
+        print(f"no events*.jsonl under {args.path!r}", file=sys.stderr)
+        return 2
+    if args.forensics:
+        return _forensics_main(args, merged)
+    skew = merge_mod.skew_summary(merged)
+    if args.json:
+        print(json.dumps({
+            "events_per_process": {str(k): v for k, v in per_process.items()},
+            "skew": skew,
+        }, indent=1))
+    else:
+        print(merge_mod.format_merge_report(merged, per_process, skew))
+    return 0
+
+
+def _forensics_main(args, events: list[dict[str, Any]]) -> int:
+    from attackfl_tpu.telemetry.forensics import (
+        forensics_summary, format_forensics,
+    )
+
+    runs = _select_runs(events, args.run_id, args.all)
+    if not runs:
+        print(f"no events recorded in {args.path!r}", file=sys.stderr)
+        return 2
+    reports = []
+    for run in runs:
+        summary = forensics_summary(run)
+        if summary is not None:
+            run_id = next((e.get("run_id") for e in run
+                           if e.get("run_id")), None)
+            reports.append((run_id, summary))
+    if not reports:
+        print("no attribution events found (no attackers configured, "
+              "fused-path-only run, or a pre-v2 artifact)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([dict(s, run_id=rid) for rid, s in reports]
+                         if args.all or len(reports) > 1
+                         else dict(reports[0][1], run_id=reports[0][0]),
+                         indent=1))
+    else:
+        print("\n\n".join(format_forensics(s, rid) for rid, s in reports))
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="attackfl-tpu metrics",
         description="Summarize a telemetry events.jsonl (per-phase p50/p95, "
-                    "rounds/s steady vs incl-compile, final metric).")
+                    "rounds/s steady vs incl-compile, final metric).  "
+                    "--merge interleaves a run directory's per-process "
+                    "events.<i>.jsonl files by ts and reports cross-host "
+                    "round skew; --forensics reports the defense's "
+                    "TPR/FPR/precision from attribution events.")
     parser.add_argument("path", nargs="?", default=".",
                         help="events.jsonl or a directory containing it")
     parser.add_argument("--run-id", type=str, default=None,
@@ -220,13 +305,24 @@ def main(argv: list[str] | None = None) -> int:
                         help="summarize every run in the file")
     parser.add_argument("--json", action="store_true",
                         help="emit the summary as JSON instead of a table")
+    parser.add_argument("--merge", action="store_true",
+                        help="interleave per-process event files "
+                             "(multi-host run) and report round skew")
+    parser.add_argument("--forensics", action="store_true",
+                        help="defense detection quality (TPR/FPR) from "
+                             "attribution events")
     args = parser.parse_args(argv)
+
+    if args.merge:
+        return _merge_main(args)
 
     try:
         events = load_events(args.path)
     except FileNotFoundError:
         print(f"no events.jsonl at {args.path!r}", file=sys.stderr)
         return 2
+    if args.forensics:
+        return _forensics_main(args, events)
     runs = split_runs(events)
     if not runs:
         print(f"no events recorded in {args.path!r}", file=sys.stderr)

@@ -19,13 +19,14 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+import uuid
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from attackfl_tpu.config import Config
+from attackfl_tpu.config import Config, parse_profile_rounds
 from attackfl_tpu.data.partition import dirichlet_label_partition
 from attackfl_tpu.data.synthetic import get_dataset
 from attackfl_tpu.eval.validation import Validation
@@ -33,16 +34,17 @@ from attackfl_tpu.models.hyper import make_cnn_hyper, make_hypernetwork
 from attackfl_tpu.ops import defenses
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.parallel.mesh import (
-    broadcast_bytes, gather_to_host, is_multiprocess, make_client_mesh,
-    make_constrain, replicate_to_mesh,
+    broadcast_bytes, broadcast_string, gather_to_host, is_multiprocess,
+    make_client_mesh, make_constrain, replicate_to_mesh,
 )
 from attackfl_tpu.registry import get_model
 from attackfl_tpu.telemetry import Logger, RoundTimer, Telemetry, print_with_color
 from attackfl_tpu.telemetry.xla import memory_analysis_bytes
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
 from attackfl_tpu.training.round import (
-    active_attack_modes, build_aggregator, build_attack_groups,
-    build_round_step, describe_attack_groups,
+    active_attack_modes, active_attacker_indices, build_aggregator,
+    build_attack_groups, build_attribution_fn, build_round_step,
+    describe_attack_groups,
 )
 from attackfl_tpu.utils import checkpoint as ckpt
 
@@ -141,13 +143,24 @@ class Simulator:
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
         # ---- telemetry --------------------------------------------------
-        # Under a multi-host mesh every process runs this Simulator SPMD;
-        # only process 0 writes event/trace files (per-process logs are the
-        # ROADMAP's multi-host-aggregation open item).
+        # Under a multi-host mesh every process runs this Simulator SPMD
+        # and EVERY process writes its own events.<process_index>.jsonl /
+        # trace.<process_index>.json, all keyed by process 0's run_id
+        # (broadcast below — a collective, symmetric because every process
+        # constructs the same Simulators in the same order).  `metrics
+        # --merge` interleaves the files for cross-host skew analysis.
         if telemetry is not None:
             self.telemetry = telemetry
-        elif self.multiprocess and jax.process_index() != 0:
-            self.telemetry = Telemetry.disabled()
+        elif self.multiprocess:
+            tcfg = getattr(cfg, "telemetry", None)
+            if tcfg is not None and tcfg.enabled:
+                run_id = (uuid.uuid4().hex[:12]
+                          if jax.process_index() == 0 else None)
+                self.telemetry = Telemetry.from_config(
+                    cfg, process_index=jax.process_index(),
+                    run_id=broadcast_string(run_id))
+            else:
+                self.telemetry = Telemetry.disabled()
         else:
             self.telemetry = Telemetry.from_config(cfg)
         self._header_emitted = False
@@ -155,6 +168,28 @@ class Simulator:
         # AOT-compiled fused chunk programs, keyed by scan length (False =
         # AOT failed for this length; fall back to the lazy jit path)
         self._fused_exe_cache: dict[int, Any] = {}
+
+        # ---- live monitor (health endpoint + stall watchdog) ------------
+        # Config-gated; process 0 only — one health endpoint per run, and
+        # the watchdog's heartbeat is the SPMD round loop every process
+        # shares anyway.  Never constructed with telemetry disabled (the
+        # null-object zero-overhead path).
+        self.monitor = None
+        if (self.telemetry.enabled and cfg.telemetry.monitor
+                and (not self.multiprocess or jax.process_index() == 0)):
+            from attackfl_tpu.telemetry.monitor import RunMonitor
+
+            self.monitor = RunMonitor(
+                self.telemetry,
+                port=cfg.telemetry.monitor_port,
+                stall_factor=cfg.telemetry.stall_factor,
+                stall_grace_seconds=cfg.telemetry.stall_grace_seconds,
+            )
+        # jax.profiler window (--profile-rounds A:B), device traces under
+        # <telemetry base>/profile
+        self._profile_window = (parse_profile_rounds(
+            cfg.telemetry.profile_rounds) if self.telemetry.enabled else None)
+        self._profiling = False
 
         # ---- validation -------------------------------------------------
         self.validation = None
@@ -205,6 +240,20 @@ class Simulator:
             aggregate = build_aggregator(self.model, cfg, test_np)
             self.aggregate = jax.jit(aggregate)
             self._aggregate_raw = aggregate
+
+        # ---- defense forensics ------------------------------------------
+        # Per-round attribution (ground-truth attackers vs. the defense's
+        # kept/removed set) — only meaningful with attackers configured,
+        # and only worth the extra jitted program when events are recorded.
+        # gmm/fltracer filter on host; the engine emits their masks
+        # directly (see _run_plain_round).
+        self._attribution = None
+        if (not self.is_hyper and self.telemetry.enabled
+                and self.attack_groups
+                and cfg.mode not in ("gmm", "fltracer")):
+            attribution = build_attribution_fn(self.model, cfg, test_np)
+            if attribution is not None:
+                self._attribution = jax.jit(attribution)
 
         self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
         self._fused_cache: dict[int, Callable] = {}
@@ -331,6 +380,54 @@ class Simulator:
             config=dataclasses.asdict(self.cfg),
         )
 
+    def _emit_attribution(self, metrics, global_params, stacked, sizes,
+                          weights_mask, broadcast_number: int,
+                          have_genuine: bool, defense_mask, rng,
+                          timer) -> None:
+        """Record the defense's per-round verdict against ground truth
+        (the ``attribution`` event — telemetry/forensics.py computes
+        TPR/FPR from these).  ``defense_mask`` is the host-side filter
+        decision (gmm/fltracer); score-based defenses recompute theirs via
+        the jitted attribution program (same rng/mask as the aggregate).
+        Per-round path only: a fused chunk is one opaque dispatch.
+        """
+        tel = self.telemetry
+        if not (tel.enabled and self.attack_groups):
+            return
+        if self._attribution is None and defense_mask is None:
+            return
+        with timer.phase("attribution"):
+            if self._attribution is not None:
+                keep, scores = self._attribution(
+                    global_params, stacked, sizes, weights_mask, rng)
+            else:
+                keep = scores = defense_mask
+            if self.multiprocess:
+                # (C,)-sized outputs, but possibly DCN-sharded — gather is
+                # a collective every process runs (symmetric SPMD path)
+                keep, scores, sizes = gather_to_host((keep, scores, sizes))
+            keep = np.asarray(keep).astype(bool)
+            scores = np.asarray(scores, dtype=np.float64)
+            reporting = np.asarray(sizes) > 0
+        active = active_attacker_indices(
+            self.attack_groups, broadcast_number, have_genuine)
+        attackers = [int(i) for i in active if reporting[i]]
+        kept = [int(i) for i in np.flatnonzero(reporting & keep)]
+        removed = [int(i) for i in np.flatnonzero(reporting & ~keep)]
+        metrics["defense_removed"] = len(removed)
+        tel.events.emit(
+            "attribution",
+            round=metrics["round"],
+            broadcast=broadcast_number,
+            mode=self.cfg.mode,
+            attackers=attackers,
+            kept=kept,
+            removed=removed,
+            non_reporting=[int(i) for i in np.flatnonzero(~reporting)],
+            scores={str(i): round(float(s), 6)
+                    for i, s in enumerate(scores)},
+        )
+
     def _count_nan_clients(self, stacked) -> int:
         """How many clients' stacked updates contain non-finite values —
         computed on the failure path only (one jitted reduction)."""
@@ -348,6 +445,9 @@ class Simulator:
         tel = self.telemetry
         if not tel.enabled:
             return
+        self._maybe_stop_profile(force=True)
+        if self.monitor is not None:
+            self.monitor.run_ended()
         tel.events.emit("counters", counters=tel.counters.snapshot())
         tel.events.emit(
             "run_end",
@@ -356,6 +456,69 @@ class Simulator:
             seconds=round(time.perf_counter() - t_start, 6),
         )
         tel.flush()
+
+    def _start_monitor(self) -> None:
+        """Bind the health endpoint (idempotent) and arm the watchdog for
+        this run."""
+        if self.monitor is None:
+            return
+        first = self.monitor.port is None
+        self.monitor.start().run_started()
+        if first:
+            print_with_color(
+                f"[monitor] http://localhost:{self.monitor.port} "
+                "(/healthz /metrics /last-round — poll with "
+                "`attackfl-tpu watch`)", "cyan")
+
+    def _maybe_start_profile(self, first_round: int,
+                             last_round: int | None = None) -> None:
+        """Open the jax.profiler trace when the upcoming round(s)
+        [first_round, last_round] overlap the --profile-rounds window.
+        Fused chunks pass their whole round range (the chunk is one
+        dispatch; profiling starts at its boundary)."""
+        if self._profile_window is None or self._profiling:
+            return
+        start, stop = self._profile_window
+        last_round = first_round if last_round is None else last_round
+        if last_round < start or first_round > stop:
+            return
+        path = os.path.join(self.telemetry.base_dir or ".", "profile")
+        try:
+            jax.profiler.start_trace(path)
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            self.telemetry.events.emit(
+                "profile", action="start_failed", path=path,
+                error=f"{type(e).__name__}: {e}"[:300])
+            self._profile_window = None  # don't retry every round
+            return
+        self._profiling = True
+        self.telemetry.events.emit("profile", action="start", path=path,
+                                   round=first_round)
+
+    def _maybe_stop_profile(self, completed_rounds: int = 0,
+                            force: bool = False) -> None:
+        if not self._profiling:
+            return
+        if not force and completed_rounds < self._profile_window[1]:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            self.telemetry.events.emit(
+                "profile", action="stop_failed",
+                error=f"{type(e).__name__}: {e}"[:300])
+        else:
+            self.telemetry.events.emit("profile", action="stop",
+                                       round=completed_rounds)
+        self._profiling = False
+
+    def close(self) -> None:
+        """Release observability resources (monitor thread, event file).
+        Safe to call twice; the Simulator itself stays usable for pure
+        compute after close (telemetry becomes flush-less no-ops)."""
+        if self.monitor is not None:
+            self.monitor.stop()
+        self.telemetry.close()
 
     # ------------------------------------------------------------------
     # one round
@@ -446,6 +609,7 @@ class Simulator:
                 tel.counters.inc("nan_clients_detected", nan_clients)
 
         weights_mask = jnp.ones((cfg.total_clients,), jnp.float32)
+        defense_mask = None  # host-side filter decision (gmm/fltracer)
         if ok and cfg.mode == "gmm":
             with timer.phase("defense"):
                 flat = np.asarray(self._ravel_stacked(stacked))
@@ -454,6 +618,7 @@ class Simulator:
             tel.counters.inc("anomalies_removed", cfg.total_clients - int(keep.sum()))
             if not keep.any():
                 ok = False  # round fails when no client survives (server.py:369-372)
+            defense_mask = np.asarray(keep, bool)
             weights_mask = jnp.asarray(keep, jnp.float32)
         elif ok and cfg.mode == "fltracer":
             with timer.phase("defense"):
@@ -465,6 +630,7 @@ class Simulator:
             mask[anomalies] = 0.0
             if not mask.any():
                 ok = False
+            defense_mask = mask > 0
             weights_mask = jnp.asarray(mask)
 
         # defense filter ∩ reporting clients: with dropout on, the defense
@@ -473,6 +639,12 @@ class Simulator:
         weights_mask = weights_mask * (sizes > 0)
         if ok and not bool(jnp.any(weights_mask > 0)):
             ok = False
+
+        if ok:
+            self._emit_attribution(
+                metrics, state["global_params"], stacked, sizes,
+                weights_mask, broadcast_number,
+                bool(state["have_genuine"]), defense_mask, k_agg, timer)
 
         new_global = state["global_params"]
         if ok:
@@ -847,6 +1019,7 @@ class Simulator:
         first_dispatch = True
         t_start = time.perf_counter()
 
+        self._start_monitor()
         while int(state["completed_rounds"]) < num_rounds:
             remaining = num_rounds - int(state["completed_rounds"])
             # Chunk sizing doubles as a compile-cache policy: the first
@@ -869,6 +1042,8 @@ class Simulator:
             # the metrics CLI can split steady vs incl-compile rates
             includes_compile = (n not in self._fused_cache
                                 and n not in self._fused_exe_cache)
+            done_before = int(state["completed_rounds"])
+            self._maybe_start_profile(done_before + 1, done_before + n)
             t0 = time.perf_counter()
             with tel.tracer.span("chunk", chunk_len=n):
                 state, metrics = self.run_scan(state, n)
@@ -896,11 +1071,16 @@ class Simulator:
                 entry["broadcast"] = broadcasts_after - n + i + 1
                 history.append(entry)
                 tel.events.round_event(entry)
+                if self.monitor is not None:
+                    # heartbeat cadence: the chunk is one dispatch, so the
+                    # amortized per-round time feeds the stall median
+                    self.monitor.record_round(entry, duration=elapsed / n)
                 if entry["ok"]:
                     consecutive_failures = 0
                 else:
                     consecutive_failures += 1
                     tel.counters.inc("rounds_failed")
+            self._maybe_stop_profile(int(state["completed_rounds"]))
             if consecutive_failures > MAX_ROUND_RETRIES:
                 self._finish_run(history, t_start)
                 raise RuntimeError(
@@ -948,12 +1128,17 @@ class Simulator:
         t_start = time.perf_counter()
         self.logger.log_info("### Application start ###")
 
+        self._start_monitor()
         while int(state["completed_rounds"]) < num_rounds:
             round_no = int(state["completed_rounds"]) + 1
             if verbose:
                 print_with_color(f"Start training round {round_no}", "yellow")
+            self._maybe_start_profile(round_no)
             state, metrics = self.run_round(state)
             history.append(metrics)
+            if self.monitor is not None:
+                self.monitor.record_round(metrics)
+            self._maybe_stop_profile(int(state["completed_rounds"]))
             if metrics["ok"]:
                 retries = 0
                 if save_checkpoints:

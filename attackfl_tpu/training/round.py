@@ -122,6 +122,19 @@ def active_attack_modes(groups: Sequence[AttackGroup], broadcast_number: int,
                    if broadcast_number >= g.attack_round})
 
 
+def active_attacker_indices(groups: Sequence[AttackGroup],
+                            broadcast_number: int,
+                            have_genuine: bool) -> list[int]:
+    """Client indices that actually attack at this broadcast — the
+    forensic ground truth (a configured attacker that has not fired yet
+    trained genuinely, so counting it as a positive would miscredit the
+    defense)."""
+    if not have_genuine:
+        return []
+    return sorted({cid for g in groups if broadcast_number >= g.attack_round
+                   for cid in g.indices})
+
+
 def build_round_step(
     model,
     cfg: Config,
@@ -364,3 +377,113 @@ def build_aggregator(
     aggregate.telemetry_info = {"program": f"aggregate[{mode}]",
                                 "geo_mask": geo_mask}
     return aggregate
+
+
+def build_attribution_fn(
+    model,
+    cfg: Config,
+    test_data: Batch | None,
+) -> Callable | None:
+    """Build the forensic-attribution program for the configured defense:
+    ``attribution(global_params, stacked, sizes, weights_mask, rng) ->
+    (keep, scores)`` where ``keep`` is the (C,) bool per-client decision
+    and ``scores`` the (C,) float evidence behind it.
+
+    This mirrors :func:`build_aggregator`'s signature and, for the
+    stochastic/score-based defenses, recomputes the SAME decision the
+    aggregate applied (same mask semantics, same rng for ScionFL's
+    quantization, same root batch for FLTrust) — it is the defense's
+    verdict made observable, not a second defense.  Element-wise defenses
+    (trimmed-mean / median) have no native per-client decision; their
+    ``keep`` is derived from the per-client survival fraction — the share
+    of parameter coordinates inside the kept window — flagged when below
+    half the nominal share (a client whose coordinates are trimmed at
+    twice the background rate is being systematically rejected).
+
+    Returns None for modes with no defense decision (fedavg) and for
+    host-side-filter modes (gmm / fltracer), where the engine already
+    holds the keep mask and emits it directly.
+    """
+    mode = cfg.mode
+    n = cfg.total_clients
+    geo_mask = cfg.client_dropout_rate > 0.0
+
+    if mode == "krum":
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            sel = aggregators.krum_select(
+                stacked, cfg.krum_f, weights_mask if geo_mask else None)
+            keep = jnp.zeros((n,), bool).at[sel].set(True)
+            return keep, keep.astype(jnp.float32)
+    elif mode in ("trimmed_mean", "median"):
+        ratio = cfg.trim_ratio
+
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            flat = pt.tree_ravel_stacked(stacked)  # (C, P)
+            mask = (weights_mask if geo_mask
+                    else jnp.ones((n,), flat.dtype))
+            valid = mask > 0
+            v = jnp.sum(mask).astype(jnp.int32)
+            if mode == "median":
+                lo = (v - 1) // 2  # torch-parity lower middle
+                hi = lo + 1
+            else:
+                kd = jnp.floor(v * ratio).astype(jnp.int32)
+                lo, hi = kd, v - kd
+            # rank of each client per coordinate (masked rows sort last,
+            # matching the aggregator's +inf sentinel)
+            order = jnp.argsort(
+                jnp.where(valid[:, None], flat, jnp.inf), axis=0)
+            ranks = jnp.argsort(order, axis=0)
+            surviving = ((ranks >= lo) & (ranks < hi)).astype(jnp.float32)
+            frac = jnp.mean(surviving, axis=1)
+            nominal = (hi - lo).astype(jnp.float32) / jnp.maximum(v, 1)
+            keep = (frac >= 0.5 * nominal) & valid
+            return keep, frac
+    elif mode == "shieldfl":
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            mask = weights_mask if geo_mask else None
+            weights = aggregators.shieldfl_weights(stacked, mask=mask)
+            valid = (weights_mask > 0 if geo_mask
+                     else jnp.ones((n,), bool))
+            mean_w = jnp.sum(weights * valid) / jnp.maximum(
+                jnp.sum(valid), 1)
+            # ShieldFL's weights are continuous; "removed" = weighted at
+            # less than half an average share of the aggregate
+            keep = (weights >= 0.5 * mean_w) & valid
+            return keep, weights
+    elif mode == "scionfl":
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            weights = aggregators.scionfl_weights(
+                stacked, sizes.astype(jnp.float32) * weights_mask, rng)
+            return weights > 0, weights
+    elif mode == "byzantine":
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            keep = aggregators.byzantine_keep(
+                stacked, cfg.byzantine_threshold,
+                weights_mask if geo_mask else None)
+            return keep > 0, keep
+    elif mode == "FLTrust":
+        if test_data is None:
+            return None
+        # identical root batch/optimizer to build_aggregator's FLTrust
+        # branch; the shared rng reproduces the same root trajectory
+        root = {k: jnp.asarray(v[:200]) for k, v in test_data.items()}
+        root_update = build_root_update(
+            model, cfg.data_name, root,
+            epochs=cfg.epochs, batch_size=100, lr=cfg.lr,
+            clip_grad_norm=cfg.clip_grad_norm,
+        )
+
+        def attribution(global_params, stacked, sizes, weights_mask, rng):
+            root_params = root_update(global_params, rng)
+            root_delta = jax.tree.map(
+                lambda a, b: a - b, root_params, global_params)
+            deltas = jax.tree.map(
+                lambda s, g: s - g[None], stacked, global_params)
+            trust = aggregators.fltrust_trust(deltas, root_delta)
+            return trust > 0, trust
+    else:
+        return None
+
+    attribution.telemetry_info = {"program": f"attribution[{mode}]"}
+    return attribution

@@ -3,10 +3,19 @@ given paths (default: the repo root, i.e. committed bench artifacts)
 against the telemetry event schema
 (``attackfl_tpu.telemetry.events.REQUIRED_FIELDS``).
 
+Schema v2 aware: per-process multi-host files (``events.<i>.jsonl``) are
+globbed too, and the v2 kinds (``stall``, ``attribution``, ``profile``)
+plus the ``process_index`` envelope field validate through the same
+``validate_event`` the writers use.  v1 artifacts stay green — v2 only
+adds kinds and optional fields.  ``tests/test_event_artifacts.py`` runs
+this over the repo's committed artifacts in tier-1 so schema drift fails
+CI instead of rotting silently.
+
 Usage: python scripts/check_event_schema.py [path ...]
 Exit 0 when every line of every found file validates; 1 otherwise.
 A path may be a directory (searched recursively for ``events.jsonl`` /
-``*.events.jsonl``) or a single file to validate directly.
+``events.<i>.jsonl`` / ``*.events.jsonl``) or a single file to validate
+directly.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ def find_event_files(path: Path) -> list[Path]:
     if path.is_file():
         return [path]
     return sorted(set(path.rglob("events.jsonl")) |
+                  set(path.rglob("events.*.jsonl")) |
                   set(path.rglob("*.events.jsonl")))
 
 

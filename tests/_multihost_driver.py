@@ -54,6 +54,10 @@ def _base_config_kwargs() -> dict:
 def main() -> None:
     coordinator, num_processes, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "fedavg"
+    # route per-process telemetry (events.<pid>.jsonl) into the shared tmp
+    # dir so the test can run `metrics --merge` over both processes' files
+    os.environ["ATTACKFL_TELEMETRY_DIR"] = os.environ.get(
+        "MULTIHOST_TMP", "/tmp/attackfl_multihost")
     from attackfl_tpu.parallel.mesh import distributed_init, make_client_mesh
 
     distributed_init(coordinator, num_processes, pid)
@@ -75,6 +79,12 @@ def main() -> None:
     )
     sim = Simulator(cfg, mesh=mesh)
     assert sim.multiprocess, "mesh should span both processes"
+    # ISSUE 2: EVERY process records telemetry into its own per-process
+    # file keyed by the run_id broadcast from process 0
+    tel = sim.telemetry
+    assert tel.enabled, "per-process telemetry should be on for all pids"
+    assert tel.events.process_index == pid, tel.events.process_index
+    assert tel.events.path.endswith(f"events.{pid}.jsonl"), tel.events.path
     state, history = sim.run(save_checkpoints=True, verbose=False)
     ok_rounds = sum(1 for h in history if h["ok"])
     auc = history[-1].get("roc_auc", float("nan"))
@@ -98,9 +108,12 @@ def main() -> None:
     scan_state, metrics = sim.run_scan(sim.init_state(), 2)
     scan_ok = int(np.asarray(metrics["ok"]).sum())
     scan_auc = float(np.asarray(metrics["roc_auc"])[-1])
+    sim.close()  # flush per-process events/trace for the merge assertions
+    resumed.close()
     print(f"MULTIHOST_OK pid={pid} ok_rounds={ok_rounds} roc_auc={auc:.4f} "
           f"scan_ok={scan_ok} scan_auc={scan_auc:.4f} "
-          f"resumed_rounds={resumed_rounds}", flush=True)
+          f"resumed_rounds={resumed_rounds} run_id={tel.events.run_id}",
+          flush=True)
 
 
 def _run_hyper(pid: int, mesh) -> None:

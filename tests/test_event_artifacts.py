@@ -1,0 +1,38 @@
+"""Tier-1 schema smoke over committed telemetry artifacts (ISSUE 2
+satellite): run scripts/check_event_schema.py across the whole repo so any
+events*.jsonl we commit — v1 bench artifacts, the v2 multi-host corpus in
+tests/data — fails CI the moment the schema drifts instead of rotting
+silently.
+"""
+
+import importlib.util
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_event_schema", REPO / "scripts" / "check_event_schema.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_committed_event_artifacts_validate(capsys):
+    lint = load_lint()
+    files = lint.find_event_files(REPO)
+    # the committed corpus must actually be picked up: the v1 regression
+    # artifact and both per-process v2 files
+    names = {str(f.relative_to(REPO)) for f in files}
+    assert "tests/data/events.v1.jsonl" in names
+    assert "tests/data/multihost/events.0.jsonl" in names
+    assert "tests/data/multihost/events.1.jsonl" in names
+    assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
+
+
+def test_v1_artifact_stays_green_standalone():
+    """The explicit backward-compat gate: schema v2 tooling must accept a
+    pure v1 file with zero violations."""
+    lint = load_lint()
+    assert lint.check_file(REPO / "tests" / "data" / "events.v1.jsonl") == []

@@ -1,0 +1,178 @@
+"""Defense forensics tests (ISSUE 2): TPR/FPR math matches hand-computed
+values exactly on scripted attribution events, the engine emits schema-
+valid attribution records for krum and trimmed-mean runs with attackers,
+and the ``metrics --forensics`` CLI reports detection quality for both.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.telemetry import EventLog, validate_event
+from attackfl_tpu.telemetry.forensics import (
+    confusion_counts, forensics_summary, format_forensics, rates,
+)
+from attackfl_tpu.telemetry.summary import load_events
+from attackfl_tpu.telemetry.summary import main as metrics_main
+from attackfl_tpu.training.round import build_attribution_fn
+
+
+# ---------------------------------------------------------------------------
+# pure math on scripted events
+# ---------------------------------------------------------------------------
+
+def test_confusion_counts_and_rates_exact():
+    counts = confusion_counts(attackers=[8, 9],
+                              kept=[0, 1, 2, 4, 5, 6, 7, 8],
+                              removed=[3, 9])
+    assert counts == {"tp": 1, "fp": 1, "fn": 1, "tn": 7}
+    assert rates(**counts) == {"tpr": 0.5, "fpr": 0.125, "precision": 0.5}
+    # empty denominators surface as None, never ZeroDivisionError
+    assert rates(tp=0, fp=0, fn=0, tn=3) == {
+        "tpr": None, "fpr": 0.0, "precision": None}
+
+
+def test_forensics_summary_micro_average(tmp_path):
+    """Known attacker mask {8,9}; a scripted defense removes {3,9} in
+    round 1 and exactly {8,9} in round 2.  Micro-averaged totals:
+    tp=3 fp=1 fn=1 tn=15 -> TPR 0.75, FPR 1/16, precision 0.75."""
+    log = EventLog(str(tmp_path / "events.jsonl"), run_id="forensic1")
+    everyone = list(range(10))
+    log.emit("attribution", round=1, broadcast=1, mode="trimmed_mean",
+             attackers=[8, 9], removed=[3, 9],
+             kept=[c for c in everyone if c not in (3, 9)])
+    log.emit("attribution", round=2, broadcast=2, mode="trimmed_mean",
+             attackers=[8, 9], removed=[8, 9],
+             kept=[c for c in everyone if c not in (8, 9)])
+    log.close()
+
+    events = load_events(str(tmp_path / "events.jsonl"))
+    for event in events:
+        assert validate_event(event) == [], event
+    summary = forensics_summary(events)
+    assert summary["mode"] == "trimmed_mean"
+    assert summary["rounds"] == 2 and summary["attack_rounds"] == 2
+    assert (summary["tp"], summary["fp"], summary["fn"], summary["tn"]) \
+        == (3, 1, 1, 15)
+    assert summary["tpr"] == 0.75
+    assert summary["fpr"] == round(1 / 16, 6)
+    assert summary["precision"] == 0.75
+    assert summary["per_round"][0]["tpr"] == 0.5
+    text = format_forensics(summary, "forensic1")
+    assert "TPR=0.7500" in text and "FPR=0.0625" in text
+
+
+def test_forensics_dedupes_multiprocess_duplicates():
+    """A merged multi-host stream carries one attribution per process for
+    the same round (SPMD-identical) — count each round once."""
+    base = dict(schema=2, ts=1.0, run_id="r", kind="attribution", round=1,
+                broadcast=1, mode="krum", attackers=[1], kept=[0],
+                removed=[1])
+    events = [dict(base, process_index=0), dict(base, process_index=1)]
+    summary = forensics_summary(events)
+    assert summary["rounds"] == 1 and summary["tp"] == 1
+
+
+def test_forensics_summary_none_without_attribution():
+    assert forensics_summary([{"kind": "round", "round": 1}]) is None
+
+
+# ---------------------------------------------------------------------------
+# attribution program unit checks
+# ---------------------------------------------------------------------------
+
+def test_build_attribution_fn_none_for_fedavg_and_host_modes():
+    cfg = Config(total_clients=4, mode="fedavg")
+    assert build_attribution_fn(None, cfg, None) is None
+
+
+def test_krum_attribution_selects_single_inlier():
+    cfg = Config(total_clients=4, mode="krum")
+    attribution = build_attribution_fn(None, cfg, None)
+    # three clustered rows + one far outlier: krum keeps ONE of the cluster
+    stacked = {"w": jnp.asarray([[0.0, 0.1], [0.05, 0.0], [0.0, 0.0],
+                                 [50.0, 50.0]])}
+    keep, scores = attribution(
+        None, stacked, jnp.ones(4), jnp.ones(4), jax.random.PRNGKey(0))
+    keep = np.asarray(keep)
+    assert keep.sum() == 1 and not keep[3]
+
+
+def test_trimmed_mean_attribution_flags_coordinate_outlier():
+    """With trim_ratio 0.25 over 4 clients (k=1), a client sitting at the
+    extreme of EVERY coordinate survives in 0% of coordinates (nominal
+    survival is 2/4) -> removed; middle clients survive ~always -> kept."""
+    cfg = Config(total_clients=4, mode="trimmed_mean", trim_ratio=0.25)
+    attribution = build_attribution_fn(None, cfg, None)
+    stacked = {"w": jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0],
+                                 [100.0, 100.0]])}
+    keep, frac = attribution(
+        None, stacked, jnp.ones(4), jnp.ones(4), jax.random.PRNGKey(0))
+    keep, frac = np.asarray(keep), np.asarray(frac)
+    assert frac[3] == 0.0 and not keep[3]  # always trimmed high
+    assert frac[0] == 0.0 and not keep[0]  # always trimmed low
+    assert keep[1] and keep[2] and frac[1] == frac[2] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# engine integration: krum + trimmed-mean runs with a real attacker
+# ---------------------------------------------------------------------------
+
+def forensic_config(log_path: str, mode: str, **kw) -> Config:
+    base = dict(
+        num_round=3, total_clients=4, mode=mode, model="CNNModel",
+        data_name="ICU", num_data_range=(48, 64), epochs=1, batch_size=32,
+        train_size=256, test_size=128, validation=False, log_path=log_path,
+        attacks=(AttackSpec(mode="Random", num_clients=1, attack_round=1,
+                            args=(1e6,)),),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("krum", {}),
+    ("trimmed_mean", {"trim_ratio": 0.25}),
+])
+def test_engine_emits_attribution_and_cli_reports(tmp_path, monkeypatch,
+                                                  capsys, mode, extra):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = forensic_config(str(tmp_path), mode, **extra)
+    sim = Simulator(cfg)
+    _state, hist = sim.run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    sim.close()
+
+    events = load_events(str(tmp_path / "events.jsonl"))
+    attributions = [e for e in events if e.get("kind") == "attribution"]
+    assert len(attributions) == 3
+    for event in attributions:
+        assert validate_event(event) == [], event
+        assert event["mode"] == mode
+        assert sorted(event["kept"] + event["removed"]) == [0, 1, 2, 3]
+    # round 1: no genuine leak yet, the attacker trains genuinely
+    assert attributions[0]["attackers"] == []
+    # once the attack fires, client 3 (last index) is ground-truth positive
+    assert attributions[1]["attackers"] == [3]
+    if mode == "krum":
+        assert all(len(e["kept"]) == 1 for e in attributions)
+    else:
+        # a 1e6-sigma Random attacker sits at the coordinate extremes —
+        # trimmed away far more often than the nominal rate
+        assert 3 in attributions[1]["removed"]
+
+    assert metrics_main([str(tmp_path), "--forensics"]) == 0
+    out = capsys.readouterr().out
+    assert f"mode={mode}" in out
+    assert "TPR=" in out and "FPR=" in out and "precision=" in out
+
+    # machine-readable variant round-trips
+    assert metrics_main([str(tmp_path), "--forensics", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["rounds"] == 3 and payload["attack_rounds"] == 2

@@ -1,0 +1,125 @@
+"""Cross-host merge tests (ISSUE 2): per-process event files interleave
+into a ts-monotone stream, every process contributes a run_header, and the
+skew report carries per-round completion spread + per-phase barrier lag
+with hand-checkable numbers (committed corpus in tests/data/multihost).
+
+The live two-process path is exercised by tests/test_multihost.py via
+tests/_multihost_driver.py; these tests cover the merge/skew math itself
+so it stays green on hosts whose jax build lacks multiprocess CPU
+collectives.
+"""
+
+import json
+import os
+
+import pytest
+
+from attackfl_tpu.telemetry import EventLog, validate_event
+from attackfl_tpu.telemetry.merge import (
+    find_process_files, merge_events, skew_summary,
+)
+from attackfl_tpu.telemetry.summary import main as metrics_main
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "multihost")
+
+
+def test_committed_corpus_merges_with_exact_skew():
+    merged, per_process = merge_events(DATA)
+    assert per_process == {0: 8, 1: 5}
+    stamps = [e["ts"] for e in merged]
+    assert stamps == sorted(stamps), "merged stream must be ts-monotone"
+    for event in merged:
+        assert validate_event(event) == [], event
+    # every process contributes a run_header under the SHARED run_id
+    headers = [e for e in merged if e["kind"] == "run_header"]
+    assert {h["process_index"] for h in headers} == {0, 1}
+    assert {h["run_id"] for h in headers} == {"mh0011223344"}
+
+    skew = skew_summary(merged)
+    assert skew["processes"] == [0, 1]
+    assert skew["run_headers"] == {"mh0011223344": [0, 1]}
+    assert skew["rounds_compared"] == 2
+    # round 1 completes at ts 100.0 / 100.12; round 2 at 101.0 / 101.3
+    assert skew["completion_skew_s"]["max"] == pytest.approx(0.3)
+    assert skew["completion_skew_s"]["max_round"] == 2
+    assert skew["completion_skew_s"]["p50"] == pytest.approx(0.21)
+    # train durations: round 1 -> 0.50 vs 0.46, round 2 -> 0.48 vs 0.50
+    train = skew["phase_lag_s"]["train"]
+    assert train["max"] == pytest.approx(0.04)
+    assert train["max_round"] == 1
+    assert train["mean"] == pytest.approx(0.03)
+    # aggregate: round 1 -> 0.02 vs 0.02, round 2 -> 0.02 vs 0.03
+    agg = skew["phase_lag_s"]["aggregate"]
+    assert agg["max"] == pytest.approx(0.01)
+    assert agg["max_round"] == 2
+
+
+def test_find_process_files_orders_and_globs(tmp_path):
+    (tmp_path / "events.1.jsonl").write_text("")
+    (tmp_path / "events.0.jsonl").write_text("")
+    (tmp_path / "events.jsonl").write_text("")
+    (tmp_path / "trace.0.json").write_text("{}")
+    files = find_process_files(str(tmp_path))
+    assert [idx for idx, _ in files] == [None, 0, 1]
+
+
+def test_merge_generated_streams_and_cli(tmp_path, capsys):
+    """Two EventLogs with a shared run_id (what the engine builds under a
+    DCN mesh) merge into the skew report the CLI prints."""
+    for pid in (0, 1):
+        log = EventLog(str(tmp_path / f"events.{pid}.jsonl"),
+                       run_id="shared01", process_index=pid)
+        log.emit("run_header", backend="cpu", num_devices=8, mode="fedavg",
+                 model="CNNModel", data_name="ICU", total_clients=8)
+        for rnd in (1, 2):
+            log.emit("round", round=rnd, broadcast=rnd, ok=True,
+                     seconds=0.2 + 0.01 * pid,
+                     phases={"train": 0.15 + 0.02 * pid, "aggregate": 0.01})
+        log.close()
+
+    merged, per_process = merge_events(str(tmp_path))
+    assert set(per_process) == {0, 1}
+    assert all(e["run_id"] == "shared01" for e in merged)
+    skew = skew_summary(merged)
+    assert skew["rounds_compared"] == 2
+    assert skew["phase_lag_s"]["train"]["max"] == pytest.approx(0.02)
+
+    assert metrics_main([str(tmp_path), "--merge"]) == 0
+    out = capsys.readouterr().out
+    assert "events.0.jsonl" in out and "events.1.jsonl" in out
+    assert "round completion skew" in out
+    assert "train" in out
+
+    assert metrics_main([str(tmp_path), "--merge", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["skew"]["rounds_compared"] == 2
+
+
+def test_merge_single_process_dir_degrades(tmp_path, capsys):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    log.emit("round", round=1, broadcast=1, ok=True, seconds=0.1)
+    log.close()
+    merged, per_process = merge_events(str(tmp_path))
+    assert list(per_process) == [None]
+    assert skew_summary(merged)["rounds_compared"] == 0
+    assert metrics_main([str(tmp_path), "--merge"]) == 0
+    assert "nothing to compare" in capsys.readouterr().out
+
+
+def test_merge_empty_dir_errors(tmp_path, capsys):
+    assert metrics_main([str(tmp_path), "--merge"]) == 2
+    assert "no events" in capsys.readouterr().err
+
+
+def test_merge_forensics_over_merged_stream(tmp_path, capsys):
+    """--merge --forensics: attribution events from both processes dedupe
+    to one verdict per round."""
+    for pid in (0, 1):
+        log = EventLog(str(tmp_path / f"events.{pid}.jsonl"),
+                       run_id="shared02", process_index=pid)
+        log.emit("attribution", round=1, broadcast=1, mode="krum",
+                 attackers=[3], kept=[0], removed=[1, 2, 3])
+        log.close()
+    assert metrics_main([str(tmp_path), "--merge", "--forensics"]) == 0
+    out = capsys.readouterr().out
+    assert "mode=krum" in out and "TPR=1.0000" in out

@@ -1,0 +1,194 @@
+"""Live monitor + stall watchdog tests (ISSUE 2): the health endpoint
+answers while a run is live, a simulated hang yields a ``stall`` event and
+a 503 ``/healthz`` (the round-5 wedge class made detectable), a healthy
+run stays 200, and disabled telemetry starts no monitor thread at all.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from attackfl_tpu.config import Config, TelemetryConfig
+from attackfl_tpu.telemetry import Counters, EventLog, NullTracer, Telemetry
+from attackfl_tpu.telemetry.monitor import MIN_STALL_SECONDS, RunMonitor
+from attackfl_tpu.telemetry.summary import load_events
+
+
+def make_telemetry(tmp_path) -> Telemetry:
+    return Telemetry(EventLog(str(tmp_path / "events.jsonl")), NullTracer(),
+                     Counters(), True, base_dir=str(tmp_path))
+
+
+def get(port: int, path: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:  # 503 arrives as an exception
+        return e.code, e.read()
+
+
+@pytest.fixture()
+def monitor(tmp_path):
+    mon = RunMonitor(make_telemetry(tmp_path), port=0,
+                     poll_interval=3600)  # ticks driven manually in tests
+    mon.start()
+    yield mon
+    mon.stop()
+
+
+def test_endpoints_healthy_run(monitor, tmp_path):
+    monitor.run_started()
+    for rnd in range(1, 4):
+        monitor.record_round({"round": rnd, "broadcast": rnd, "ok": True,
+                              "seconds": 0.1, "roc_auc": 0.9,
+                              "phases": {"train": 0.08, "validate": 0.01}})
+    code, body = get(monitor.port, "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+    assert json.loads(body)["rounds_completed"] == 3
+
+    code, body = get(monitor.port, "/metrics")
+    text = body.decode()
+    assert code == 200
+    assert "attackfl_rounds_completed 3" in text
+    assert "attackfl_stalled 0" in text
+    assert 'attackfl_last_round_phase_seconds{phase="train"} 0.08' in text
+    assert "attackfl_round_seconds_median 0.1" in text
+
+    code, body = get(monitor.port, "/last-round")
+    last = json.loads(body)
+    assert code == 200 and last["round"] == 3 and last["roc_auc"] == 0.9
+
+    code, _ = get(monitor.port, "/nonsense")
+    assert code == 404
+
+
+def test_stall_detected_and_cleared(monitor, tmp_path):
+    monitor.run_started()
+    for rnd in range(1, 5):
+        monitor.record_round({"round": rnd, "broadcast": rnd, "ok": True,
+                              "seconds": 0.1})
+    # threshold = max(10 x median(0.1), floor) = MIN_STALL_SECONDS
+    assert monitor.stall_threshold_seconds() == MIN_STALL_SECONDS
+    now = time.monotonic()
+    assert monitor.check_stall(now=now) is False
+    assert get(monitor.port, "/healthz")[0] == 200
+
+    hang = now + MIN_STALL_SECONDS + 1.0
+    assert monitor.check_stall(now=hang) is True
+    code, body = get(monitor.port, "/healthz")
+    assert code == 503
+    payload = json.loads(body)
+    assert payload["status"] == "stalled"
+    assert payload["rounds_completed"] == 4
+    assert "attackfl_stalled 1" in get(monitor.port, "/metrics")[1].decode()
+
+    # the stall event is emitted exactly once per transition
+    monitor.check_stall(now=hang + 1.0)
+    stalls = [e for e in load_events(str(tmp_path / "events.jsonl"))
+              if e.get("kind") == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["rounds_completed"] == 4
+    assert stalls[0]["seconds_since_round"] > stalls[0]["threshold_seconds"]
+
+    # a completing round clears the stall
+    monitor.record_round({"round": 5, "broadcast": 5, "ok": True,
+                          "seconds": 0.1})
+    assert get(monitor.port, "/healthz")[0] == 200
+
+
+def test_grace_window_covers_first_compile(monitor):
+    """Before any round completes (compiles — and the init-wedge class)
+    the threshold is the grace window, not the MIN floor."""
+    monitor.run_started()
+    assert monitor.stall_threshold_seconds() == monitor.stall_grace_seconds
+    beat = time.monotonic()
+    assert monitor.check_stall(now=beat + monitor.stall_grace_seconds - 1) \
+        is False
+    assert monitor.check_stall(now=beat + monitor.stall_grace_seconds + 1) \
+        is True
+
+
+def test_watchdog_disarmed_outside_runs(monitor):
+    # never armed: no stall no matter how much time "passes"
+    assert monitor.check_stall(now=time.monotonic() + 1e6) is False
+    monitor.run_started()
+    monitor.record_round({"round": 1, "broadcast": 1, "ok": True,
+                          "seconds": 0.1})
+    monitor.run_ended()  # a finished run is not a stalled one
+    assert monitor.check_stall(now=time.monotonic() + 1e6) is False
+
+
+def tiny_config(log_path: str, **kw) -> Config:
+    base = dict(
+        num_round=2, total_clients=4, mode="fedavg", model="CNNModel",
+        data_name="ICU", num_data_range=(48, 64), epochs=1, batch_size=32,
+        train_size=256, test_size=128, validation=True, log_path=log_path,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_engine_monitor_integration(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = tiny_config(str(tmp_path),
+                      telemetry=TelemetryConfig(monitor=True, monitor_port=0))
+    sim = Simulator(cfg)
+    assert sim.monitor is not None and sim.monitor.port is None  # not bound yet
+    try:
+        _state, hist = sim.run(save_checkpoints=False, verbose=False)
+        assert all(h["ok"] for h in hist)
+        assert sim.monitor.port is not None
+        code, body = get(sim.monitor.port, "/healthz")
+        assert code == 200
+        assert json.loads(body)["rounds_completed"] == 2
+        code, body = get(sim.monitor.port, "/last-round")
+        assert json.loads(body)["round"] == 2
+        text = get(sim.monitor.port, "/metrics")[1].decode()
+        assert 'attackfl_counter{name="checkpoint_writes"}' not in text
+        assert "attackfl_rounds_completed 2" in text
+    finally:
+        sim.close()
+    # a healthy run never recorded a stall
+    events = load_events(str(tmp_path / "events.jsonl"))
+    assert not [e for e in events if e.get("kind") == "stall"]
+
+
+def test_disabled_telemetry_has_no_monitor(tmp_path, monkeypatch):
+    """telemetry.enabled=false must keep the full null-object path: no
+    files, no monitor thread even when monitor: true is configured."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = tiny_config(str(tmp_path), telemetry=TelemetryConfig(
+        enabled=False, monitor=True, monitor_port=0))
+    sim = Simulator(cfg)
+    assert sim.monitor is None
+    _state, hist = sim.run(num_rounds=1, save_checkpoints=False, verbose=False)
+    assert hist[0]["ok"]
+    leftovers = {p.name for p in tmp_path.iterdir()}
+    assert leftovers <= {"app.log"}, leftovers  # console log only, no telemetry
+
+
+def test_watch_cli_once(monitor, capsys):
+    from attackfl_tpu import cli
+
+    monitor.run_started()
+    monitor.record_round({"round": 7, "broadcast": 7, "ok": True,
+                          "seconds": 0.1, "roc_auc": 0.88})
+    url = f"http://127.0.0.1:{monitor.port}"
+    assert cli.watch_main([url, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "round 7" in out and "roc_auc=0.8800" in out
+
+    # stalled run -> exit 1
+    monitor.check_stall(now=time.monotonic() + monitor.stall_grace_seconds + 1)
+    assert cli.watch_main([url, "--once"]) == 1
+
+    # unreachable -> exit 2
+    assert cli.watch_main(["http://127.0.0.1:9", "--once"]) == 2

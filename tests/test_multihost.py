@@ -66,6 +66,26 @@ def test_two_process_round(tmp_path):
     auc1 = lines[1].split("roc_auc=")[1]
     assert auc0 == auc1, (auc0, auc1)
 
+    # ISSUE 2: per-process telemetry under the SHARED run_id...
+    run_ids = {line.split("run_id=")[1].split()[0] for line in lines}
+    assert len(run_ids) == 1, run_ids
+    # ...merges into one ts-monotone stream with a run_header from each
+    # process and a non-empty cross-host skew report (the merge/skew math
+    # itself is unit-tested in tests/test_merge.py)
+    from attackfl_tpu.telemetry.merge import merge_events, skew_summary
+
+    merged, per_process = merge_events(str(tmp_path))
+    assert {0, 1} <= set(per_process), per_process
+    stamps = [e["ts"] for e in merged]
+    assert stamps == sorted(stamps)
+    header_pids = {e.get("process_index") for e in merged
+                   if e["kind"] == "run_header"}
+    assert {0, 1} <= header_pids, header_pids
+    skew = skew_summary(merged)
+    assert skew["rounds_compared"] >= 1
+    assert skew["completion_skew_s"] is not None
+    assert skew["phase_lag_s"], skew
+
 
 @pytest.mark.slow
 def test_two_process_hyper_round(tmp_path):

@@ -221,6 +221,76 @@ def test_validate_event_catches_bad_records():
     assert validate_event(good) == []
 
 
+def test_validate_event_schema_v2_kinds():
+    stall = {"schema": 2, "kind": "stall", "ts": 0.0, "process_index": 0,
+             "seconds_since_round": 12.5, "threshold_seconds": 5.0,
+             "rounds_completed": 3}
+    assert validate_event(stall) == []
+    attribution = {"schema": 2, "kind": "attribution", "ts": 0.0,
+                   "round": 2, "mode": "krum", "attackers": [3],
+                   "kept": [0], "removed": [1, 2, 3]}
+    assert validate_event(attribution) == []
+    profile = {"schema": 2, "kind": "profile", "ts": 0.0, "action": "start"}
+    assert validate_event(profile) == []
+    # the process_index envelope field is optional but type-checked
+    bad_pid = {"schema": 2, "kind": "profile", "ts": 0.0, "action": "x",
+               "process_index": "zero"}
+    assert any("process_index" in e for e in validate_event(bad_pid))
+    # v1 records (no process_index, schema 1) remain valid under v2 tooling
+    v1 = {"schema": 1, "kind": "checkpoint", "ts": 0.0, "path": "x"}
+    assert validate_event(v1) == []
+    missing_field = {"schema": 2, "kind": "attribution", "ts": 0.0,
+                     "round": 1, "mode": "krum", "attackers": []}
+    assert any("missing field 'kept'" in e
+               for e in validate_event(missing_field))
+
+
+def test_load_events_counts_truncated_mid_write_lines(tmp_path, capsys):
+    """Regression (ISSUE 2 satellite): the docstring always promised the
+    '_skipped' sentinel; the code silently dropped malformed lines.  A
+    file truncated mid-write — the wedge scenario — must surface its
+    damage in the metrics output."""
+    log = EventLog(str(tmp_path / "events.jsonl"), run_id="trunc1")
+    log.emit("run_header", backend="cpu", num_devices=1, mode="fedavg",
+             model="M", data_name="ICU", total_clients=2)
+    log.round_event({"round": 1, "broadcast": 1, "ok": True, "seconds": 0.5})
+    log.close()
+    with open(tmp_path / "events.jsonl", "a") as fh:
+        fh.write('{"schema": 2, "kind": "round", "ts": 1.0, "rou')  # cut off
+
+    events = load_events(str(tmp_path / "events.jsonl"))
+    sentinels = [e for e in events if e.get("kind") == "_skipped"]
+    assert len(sentinels) == 1 and sentinels[0]["count"] == 1
+    summary = summarize(events)
+    assert summary["skipped_lines"] == 1
+    assert summary["rounds_attempted"] == 1  # the intact record still counts
+    assert "skipped: 1 malformed line(s)" in format_summary(summary)
+
+    from attackfl_tpu.telemetry.summary import main as metrics_main
+    assert metrics_main([str(tmp_path)]) == 0
+    assert "skipped: 1 malformed" in capsys.readouterr().out
+
+
+def test_telemetry_from_config_per_process_routing(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = Config(log_path=str(tmp_path))
+    tel = Telemetry.from_config(cfg, process_index=1, run_id="sharedrunid1")
+    record = tel.events.emit("checkpoint", path="x")
+    tel.close()
+    assert tel.events.path.endswith("events.1.jsonl")
+    assert tel.tracer.path.endswith("trace.1.json")
+    assert tel.events.run_id == "sharedrunid1"
+    assert record["process_index"] == 1 and record["run_id"] == "sharedrunid1"
+    assert validate_event(record) == []
+    # explicit path overrides get the process suffix spliced in (N writers
+    # on a shared filesystem must never clobber one file)
+    cfg2 = Config(log_path=str(tmp_path), telemetry=TelemetryConfig(
+        events_path=str(tmp_path / "custom.jsonl")))
+    tel2 = Telemetry.from_config(cfg2, process_index=0)
+    tel2.close()
+    assert tel2.events.path.endswith("custom.0.jsonl")
+
+
 def test_metric_line_is_schema_valid():
     record = metric_line("fl_rounds_per_sec_100c", 0.5, unit="rounds/s",
                          vs_baseline=0.3, detail={"config": "x"})
