@@ -136,6 +136,28 @@ def server_main(argv=None) -> None:
     parser.add_argument("--no-wait", action="store_true",
                         help="skip client rendezvous; attackers come from config")
     parser.add_argument("--rounds", type=int, default=None, help="override num-round")
+    # --- round-pipeline / persistence overrides (config: server: section) ---
+    parser.add_argument("--pipeline", action="store_true",
+                        help="depth-1 pipelined round executor: round N+1 "
+                             "dispatches before round N's success flag "
+                             "materializes (server.pipeline)")
+    parser.add_argument("--checkpoint-async", action="store_true",
+                        help="background checkpoint writer: serialize + "
+                             "write + fsync off the round loop "
+                             "(server.checkpoint-async)")
+    parser.add_argument("--validation-every", type=int, default=None,
+                        metavar="K",
+                        help="validate every K-th broadcast "
+                             "(server.validation-every; default 1)")
+    parser.add_argument("--validation-async", action="store_true",
+                        help="validate round N while round N+1 trains; "
+                             "results land in telemetry, no acceptance "
+                             "gate (server.validation-async)")
+    parser.add_argument("--compile-cache", type=str, default=None,
+                        metavar="DIR",
+                        help="JAX persistent compilation cache directory "
+                             "(compile-cache-dir; ATTACKFL_COMPILE_CACHE "
+                             "env var also works)")
     # --- observability overrides (config: telemetry: section) ---
     parser.add_argument("--monitor", action="store_true",
                         help="serve /healthz /metrics /last-round + stall "
@@ -190,6 +212,19 @@ def server_main(argv=None) -> None:
     if overrides:
         cfg = cfg.replace(
             telemetry=dataclasses.replace(cfg.telemetry, **overrides))
+    perf_overrides = {}
+    if args.pipeline:
+        perf_overrides["pipeline"] = True
+    if args.checkpoint_async:
+        perf_overrides["checkpoint_async"] = True
+    if args.validation_every is not None:
+        perf_overrides["validation_every"] = args.validation_every
+    if args.validation_async:
+        perf_overrides["validation_async"] = True
+    if args.compile_cache is not None:
+        perf_overrides["compile_cache_dir"] = args.compile_cache
+    if perf_overrides:
+        cfg = cfg.replace(**perf_overrides)
     base = os.path.dirname(os.path.abspath(args.config))
 
     if not args.no_wait:

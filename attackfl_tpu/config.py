@@ -192,6 +192,29 @@ class Config:
     # os.path.exists gate).
     reload_parameters_per_round: bool = False
     validation: bool = True
+    # Validation cadence: evaluate every k-th broadcast (1 = every round,
+    # the reference cadence).  Skipped rounds have no validation gate —
+    # they pass/fail on training alone.  Keyed on the broadcast clock so
+    # the synchronous, pipelined and fused paths agree on which rounds
+    # validate (the clock advances identically on all three).
+    validation_every: int = 1
+    # Async validation: round N's params are evaluated while round N+1
+    # trains; results fold into telemetry (a ``validation`` event) and the
+    # round's history entry when they land.  The validation verdict no
+    # longer gates round acceptance — an opt-in semantic change (the
+    # reference blocks every round on the gate, server.py:539-547).
+    validation_async: bool = False
+    # Depth-1 software-pipelined round executor (Simulator.run): round N's
+    # success flag resolves on the host while round N+1's programs are
+    # already dispatched; a failed round keeps the previous params through
+    # the same accept-select the fused scan uses.  Off by default — the
+    # synchronous path stays the parity reference.
+    pipeline: bool = False
+    # Background checkpoint persistence (utils/checkpoint
+    # AsyncCheckpointWriter): the device->host gather stays on the round
+    # loop, serialization + file write + fsync move to a writer thread
+    # with last-write-wins coalescing and a drain-on-close guarantee.
+    checkpoint_async: bool = False
     num_data_range: tuple[int, int] = (12000, 15000)
     genuine_rate: float = 0.5
     random_seed: int = 1
@@ -247,6 +270,11 @@ class Config:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log_path: str = "."
     checkpoint_dir: str = "."
+    # JAX persistent compilation cache directory: compiled XLA programs
+    # survive process restarts, so repeat runs skip the multi-minute
+    # first-dispatch compile entirely.  Empty = disabled.  The
+    # ``ATTACKFL_COMPILE_CACHE`` env var overrides this (bench/CI harness).
+    compile_cache_dir: str = ""
     # Krum's assumed-malicious count f.  The reference computes
     # f = int(n * genuine_rate) from a field hardcoded to 0.0
     # (server.py:109,384) so effectively f=0; we default to 0 for parity but
@@ -285,6 +313,11 @@ class Config:
             )
         if self.scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.validation_every < 1:
+            raise ValueError(
+                f"validation_every must be >= 1 (1 = every round; disable "
+                f"validation with validation: false), got {self.validation_every}"
+            )
         if self.reload_parameters_per_round and not self.load_parameters:
             raise ValueError(
                 "reload_parameters_per_round replicates the reference's "
@@ -417,6 +450,13 @@ def config_from_dict(raw: dict) -> Config:
             _get(server, "parameters", {}), "reload-per-round",
             defaults.reload_parameters_per_round)),
         validation=bool(_get(server, "validation", True)),
+        validation_every=int(_get(server, "validation-every",
+                                  defaults.validation_every)),
+        validation_async=bool(_get(server, "validation-async",
+                                   defaults.validation_async)),
+        pipeline=bool(_get(server, "pipeline", defaults.pipeline)),
+        checkpoint_async=bool(_get(server, "checkpoint-async",
+                                   defaults.checkpoint_async)),
         num_data_range=(int(ndr[0]), int(ndr[1])),
         genuine_rate=float(_get(server, "genuine-rate", defaults.genuine_rate)),
         random_seed=int(_get(server, "random-seed", defaults.random_seed) or 0),
@@ -462,6 +502,8 @@ def config_from_dict(raw: dict) -> Config:
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),
+        compile_cache_dir=str(_get(raw, "compile-cache-dir",
+                                   defaults.compile_cache_dir)),
         local_backend=str(_get(mesh, "local-backend", defaults.local_backend)),
         krum_f=int(_get(server, "krum-f", defaults.krum_f)),
         trim_ratio=float(_get(server, "trim-ratio", defaults.trim_ratio)),

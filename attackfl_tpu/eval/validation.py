@@ -191,6 +191,34 @@ class Validation:
         self._record(ok, metrics)
         return ok, metrics
 
+    def test_async(self, params: Any) -> dict[str, Any]:
+        """Dispatch the evaluation program WITHOUT materializing results:
+        returns the dict of in-flight device arrays.  The caller resolves
+        it later with :meth:`resolve_async` — by then the device has
+        evaluated round N's params while round N+1 was training
+        (validation_async mode; the verdict does not gate the round)."""
+        return self._eval(params)
+
+    def test_hyper_async(self, stacked_params: Any) -> dict[str, Any]:
+        """Hyper-mode variant of :meth:`test_async` (dispatch, no sync)."""
+        if self._eval_hyper is None:
+            raise ValueError(
+                f"Not found hyper test function for data name {self.data_name}")
+        return self._eval_hyper(stacked_params)
+
+    def resolve_async(self, out: dict[str, Any],
+                      record: bool = True) -> tuple[bool, dict[str, float]]:
+        """Materialize a :meth:`test_async`/:meth:`test_hyper_async`
+        result (blocks until the dispatched evaluation finishes).
+        ``record=False`` leaves failure accounting to the caller (the
+        engine emits one combined ``validation`` event instead)."""
+        host = {k: np.asarray(v) for k, v in out.items()}
+        ok = bool(host.pop("ok"))
+        metrics = {k: float(v) for k, v in host.items()}
+        if record:
+            self._record(ok, metrics)
+        return ok, metrics
+
     def test_hyper(self, stacked_params: Any) -> tuple[bool, dict[str, float]]:
         if self._eval_hyper is None:
             raise ValueError(f"Not found hyper test function for data name {self.data_name}")

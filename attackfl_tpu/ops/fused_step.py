@@ -576,6 +576,24 @@ def build_fused_local_update(dataset, *, epochs, batch_size, lr,
         gm = zeros_like_groups(gp)
         gv = zeros_like_groups(gp)
 
+        # Donate the packed params/m/v groups across epoch dispatches: the
+        # pallas_call already aliases state in->out WITHIN one epoch
+        # (input_output_aliases below); jit donation extends that to the
+        # eager multi-epoch loop (tests / tpu_validate_pallas), so the
+        # per-client optimizer state never holds two HBM copies.  Under an
+        # outer jit (the engine's round_step) this inlines and the hint is
+        # a no-op.  Interpret mode stays unjitted — it is the CPU
+        # correctness path, and donation buys nothing there.
+        step_fn = run_epoch
+        if not interpret:
+            step_fn = jax.jit(
+                functools.partial(
+                    run_epoch, lr=lr,
+                    clip=clip_grad_norm if clip_grad_norm else 0.0,
+                    drop_attn=dropout[0], drop_block=dropout[1],
+                    drop_head=dropout[2], g_clients=G, interpret=False),
+                donate_argnums=(0, 1, 2))
+
         # same per-client key schedule as the JAX path (local.py):
         # per client: epoch keys = split(rng, E); per epoch (k_perm, k_drop)
         eks = jax.vmap(lambda k: jax.random.split(k, epochs))(keys)  # [C,E,...]
@@ -598,11 +616,16 @@ def build_fused_local_update(dataset, *, epochs, batch_size, lr,
                 batch = jnp.concatenate(
                     [batch, jnp.zeros((C_pad - C, nb, B, 32), jnp.float32)],
                     axis=0)
-            gp, gm, gv, sums = run_epoch(
-                gp, gm, gv, batch, seed0 + np.int32(e), e * nb,
-                lr=lr, clip=clip_grad_norm if clip_grad_norm else 0.0,
-                drop_attn=dropout[0], drop_block=dropout[1],
-                drop_head=dropout[2], g_clients=G, interpret=interpret)
+            if interpret:
+                gp, gm, gv, sums = run_epoch(
+                    gp, gm, gv, batch, seed0 + np.int32(e), e * nb,
+                    lr=lr, clip=clip_grad_norm if clip_grad_norm else 0.0,
+                    drop_attn=dropout[0], drop_block=dropout[1],
+                    drop_head=dropout[2], g_clients=G, interpret=True)
+            else:
+                gp, gm, gv, sums = step_fn(
+                    gp, gm, gv, batch, seed0 + np.int32(e),
+                    jnp.asarray(e * nb, jnp.int32))
             ok = ok & jnp.isfinite(sums[:C])
             loss_sums = sums
         new_stacked = unpack_params(gp, padded)

@@ -166,7 +166,9 @@ def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
         "retries": len(retries),
         "phases": per_phase,
         "rates": rates,
-        "compiles": [{k: c.get(k) for k in ("program", "seconds")}
+        "compiles": [{k: c.get(k) for k in
+                      ("program", "seconds", "cache_hits", "cache_misses")
+                      if c.get(k) is not None}
                      for c in compiles],
         "final": final,
         "counters": counters,
@@ -205,8 +207,13 @@ def format_summary(summary: dict[str, Any]) -> str:
             parts.append(f"incl-compile={rates['rounds_per_sec_incl_compile']}")
         lines.append("rounds/s: " + ", ".join(parts))
     for compile_event in summary["compiles"]:
-        lines.append(f"compile: {compile_event['program']} "
-                     f"{compile_event['seconds']:.2f}s")
+        line = (f"compile: {compile_event['program']} "
+                f"{compile_event['seconds']:.2f}s")
+        if "cache_hits" in compile_event or "cache_misses" in compile_event:
+            # persistent-cache stats event (training/engine._finish_run)
+            line += (f" [persistent cache: {compile_event.get('cache_hits', 0)}"
+                     f" hit(s), {compile_event.get('cache_misses', 0)} miss(es)]")
+        lines.append(line)
     if summary["final"]:
         lines.append("final: " + " ".join(
             f"{k}={v:.4f}" for k, v in summary["final"].items()))

@@ -20,7 +20,17 @@ import dataclasses
 import os
 import time
 import uuid
+import warnings
 from typing import Any, Callable
+
+# Donation here is for EARLY FREE (the runtime may release a donated
+# buffer after its last in-program use, cutting peak HBM), not only for
+# in-place aliasing; XLA warns whenever a donated buffer has no
+# same-shaped output to alias (e.g. the (C, P) stacked deltas donated
+# into an aggregation that outputs (P,)).  That is the expected case, not
+# a bug — misuse (reuse after donation) raises RuntimeError instead.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +49,10 @@ from attackfl_tpu.parallel.mesh import (
 )
 from attackfl_tpu.registry import get_model
 from attackfl_tpu.telemetry import Logger, RoundTimer, Telemetry, print_with_color
-from attackfl_tpu.telemetry.xla import memory_analysis_bytes
+from attackfl_tpu.telemetry.xla import (
+    ENV_COMPILE_CACHE, compile_cache_stats, enable_compile_cache,
+    memory_analysis_bytes,
+)
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
 from attackfl_tpu.training.round import (
     active_attack_modes, active_attacker_indices, build_aggregator,
@@ -82,6 +95,16 @@ class Simulator:
         self.cfg = cfg
         self.logger = logger or Logger(f"{cfg.log_path}/app.log")
         self.model = get_model(cfg.model)
+
+        # ---- persistent compilation cache -------------------------------
+        # Enabled before any program is built so every jit below can hit
+        # it.  Process-wide (jax config); env var wins over config so the
+        # bench/CI harness can redirect without touching configs.
+        self._compile_cache_dir = (
+            os.environ.get(ENV_COMPILE_CACHE) or cfg.compile_cache_dir or None)
+        if self._compile_cache_dir:
+            enable_compile_cache(self._compile_cache_dir)
+        self._cache_stats_start = compile_cache_stats()
 
         train_np = train_data if train_data is not None else get_dataset(
             cfg.data_name, "train", cfg.train_size, cfg.random_seed
@@ -220,7 +243,10 @@ class Simulator:
             hyper_update, self.hyper_tx = build_hyper_update(
                 cfg, self.hnet_apply, cfg.total_clients
             )
-            self.hyper_update = jax.jit(hyper_update)
+            # donate the stacked client-params tree: the hnet step is its
+            # last consumer each round, so its HBM copy is recycled in
+            # place instead of living alongside the update's temporaries
+            self.hyper_update = jax.jit(hyper_update, donate_argnums=(2,))
             self._hyper_update_raw = hyper_update
             self.detector = None
             if cfg.hyper_detection.enable:
@@ -238,7 +264,13 @@ class Simulator:
             self.round_step = jax.jit(round_step)
             self._round_step_raw = round_step
             aggregate = build_aggregator(self.model, cfg, test_np)
-            self.aggregate = jax.jit(aggregate)
+            # donate the stacked client deltas — the (C, P)-scale buffer.
+            # Aggregation is dispatched after every other consumer (the
+            # host defenses and the attribution program read it first), so
+            # XLA reuses its HBM for the reduction instead of holding a
+            # second copy.  Do NOT pass the same stacked tree to anything
+            # after self.aggregate.
+            self.aggregate = jax.jit(aggregate, donate_argnums=(1,))
             self._aggregate_raw = aggregate
 
         # ---- defense forensics ------------------------------------------
@@ -257,6 +289,21 @@ class Simulator:
 
         self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
         self._fused_cache: dict[int, Callable] = {}
+        # pipelined single-round programs, keyed by (include_eval, donate)
+        self._pipeline_cache: dict[tuple, Callable] = {}
+        self._pipeline_exe_cache: dict[tuple, Any] = {}
+        # reload_parameters_per_round: (mtime_ns, size) -> cached params so
+        # an unchanged checkpoint file costs a stat, not a deserialize
+        self._reload_cache: tuple[tuple[int, int], Any] | None = None
+        # validation_async: (history entry, round, in-flight device dict)
+        self._inflight_validations: list[tuple[dict, int, dict]] = []
+        # checkpoint_async: background serialize+write+fsync thread; the
+        # device->host gather stays on the round loop (_save_checkpoint)
+        self._ckpt_writer = None
+        if cfg.checkpoint_async:
+            self._ckpt_writer = ckpt.AsyncCheckpointWriter(
+                on_write=lambda _path: self.telemetry.counters.inc(
+                    "checkpoint_writes"))
 
     # ------------------------------------------------------------------
     # state
@@ -377,6 +424,7 @@ class Simulator:
             attacks=describe_attack_groups(self.attack_groups),
             programs=programs,
             jax_version=jax.__version__,
+            compile_cache_dir=self._compile_cache_dir or "",
             config=dataclasses.asdict(self.cfg),
         )
 
@@ -440,14 +488,36 @@ class Simulator:
         return int(self._nan_counter(stacked))
 
     def _finish_run(self, history: list[dict[str, Any]], t_start: float) -> None:
-        """Terminal events of a run()/run_fast() call: the counters
-        snapshot, a run_end record, and the Chrome trace file."""
+        """Terminal work of a run()/run_fast() call: resolve in-flight
+        async validations, drain the background checkpoint writer (the
+        final state is durably on disk before the call returns), then the
+        counters snapshot, compile-cache stats, a run_end record, and the
+        Chrome trace file."""
+        self._resolve_inflight_validations()
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain()
         tel = self.telemetry
         if not tel.enabled:
             return
         self._maybe_stop_profile(force=True)
         if self.monitor is not None:
             self.monitor.run_ended()
+        if self._compile_cache_dir:
+            stats = compile_cache_stats()
+            start, self._cache_stats_start = self._cache_stats_start, stats
+            tel.events.emit(
+                "compile",
+                program="persistent_cache",
+                seconds=round(stats["backend_compile_seconds"]
+                              - start.get("backend_compile_seconds", 0.0), 6),
+                cache_dir=self._compile_cache_dir,
+                cache_hits=int(stats["cache_hits"] - start.get("cache_hits", 0)),
+                cache_misses=int(stats["cache_misses"]
+                                 - start.get("cache_misses", 0)),
+                cache_retrieval_seconds=round(
+                    stats["cache_retrieval_seconds"]
+                    - start.get("cache_retrieval_seconds", 0.0), 6),
+            )
         tel.events.emit("counters", counters=tel.counters.snapshot())
         tel.events.emit(
             "run_end",
@@ -456,6 +526,23 @@ class Simulator:
             seconds=round(time.perf_counter() - t_start, 6),
         )
         tel.flush()
+
+    def _resolve_inflight_validations(self) -> None:
+        """Materialize async-validation results (``validation_async``) and
+        fold them into telemetry + the round's history entry when they
+        land.  The verdict never gates the round in async mode."""
+        while self._inflight_validations:
+            entry, round_no, out = self._inflight_validations.pop(0)
+            val_ok, val_metrics = self.validation.resolve_async(
+                out, record=False)
+            entry.update(val_metrics)
+            entry["validation_ok"] = val_ok
+            if not val_ok:
+                self.telemetry.counters.inc("validation_failures")
+            self.telemetry.events.emit(
+                "validation", ok=val_ok, round=round_no,
+                data_name=self.validation.data_name, background=True,
+                **val_metrics)
 
     def _start_monitor(self) -> None:
         """Bind the health endpoint (idempotent) and arm the watchdog for
@@ -513,11 +600,16 @@ class Simulator:
         self._profiling = False
 
     def close(self) -> None:
-        """Release observability resources (monitor thread, event file).
-        Safe to call twice; the Simulator itself stays usable for pure
-        compute after close (telemetry becomes flush-less no-ops)."""
+        """Release observability + persistence resources (monitor thread,
+        checkpoint writer, event file).  Safe to call twice; the Simulator
+        itself stays usable for pure compute after close (telemetry
+        becomes flush-less no-ops; a closed checkpoint writer falls back
+        to synchronous saves)."""
         if self.monitor is not None:
             self.monitor.stop()
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()
+            self._ckpt_writer = None
         self.telemetry.close()
 
     # ------------------------------------------------------------------
@@ -528,17 +620,32 @@ class Simulator:
         """Persist ``state`` (reference cadence: every successful round,
         server.py:549-553).  Multi-host: gather the DCN-sharded tree to
         host (one all-gather over DCN) and let process 0 alone write the
-        file — every process participates in the gather collective."""
+        file — every process participates in the gather collective.
+
+        With ``cfg.checkpoint_async`` the device->host gather stays here
+        (on the round loop) but serialization, the file write and the
+        fsync move to the background writer: submit is O(gather) and
+        rapid rounds coalesce to the newest state (last-write-wins).  The
+        synchronous path increments ``checkpoint_writes`` directly; the
+        async path counts submits here and completed writes from the
+        writer's callback."""
         path = ckpt.checkpoint_path(self.cfg)
-        with self.telemetry.tracer.span("checkpoint"):
+        writer = self._ckpt_writer
+        with self.telemetry.tracer.span("checkpoint", background=writer is not None):
+            target = state
+            write_here = True
             if self.multiprocess:
-                host = gather_to_host(state)
-                if jax.process_index() == 0:
-                    ckpt.save_state(path, host)
-            else:
-                ckpt.save_state(path, state)
-        self.telemetry.counters.inc("checkpoint_writes")
-        self.telemetry.events.emit("checkpoint", path=path)
+                target = gather_to_host(state)
+                write_here = jax.process_index() == 0
+            if write_here:
+                if writer is not None:
+                    writer.submit(path, ckpt.host_state(target))
+                    self.telemetry.counters.inc("checkpoint_submits")
+                else:
+                    ckpt.save_state(path, target)
+                    self.telemetry.counters.inc("checkpoint_writes")
+        self.telemetry.events.emit("checkpoint", path=path,
+                                   background=writer is not None)
 
     def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
         """Execute one broadcast->train->attack->aggregate->validate round.
@@ -550,6 +657,9 @@ class Simulator:
         """
         cfg = self.cfg
         self._emit_run_header()
+        # async validations dispatched last round resolve here, AFTER the
+        # device has had the inter-round host window to evaluate them
+        self._resolve_inflight_validations()
         t0 = time.perf_counter()
         if cfg.reload_parameters_per_round and not self.is_hyper:
             # reference fidelity (server.py:578-586): with parameters.load,
@@ -559,11 +669,24 @@ class Simulator:
             # aggregate reaches clients — replicate it with per-round
             # checkpoint saving on (run(save_checkpoints=True)); with
             # saving off this pins training to the file's params instead.
-            # A missing file is a no-op (os.path.exists gate).
+            # A missing file is a no-op (os.path.exists gate).  The
+            # re-read is mtime/size-cached: an unchanged file costs one
+            # stat instead of a full msgpack deserialize on the critical
+            # path (the async checkpoint writer rewrites it off-thread, so
+            # the cache also absorbs the submit-to-write latency window).
             path = ckpt.checkpoint_path(cfg)
             try:
-                fresh = ckpt.load_state(path, state)
-                state = dict(state, global_params=fresh["global_params"])
+                st = os.stat(path)
+                key = (st.st_mtime_ns, st.st_size)
+                if (self._reload_cache is not None
+                        and self._reload_cache[0] == key):
+                    params = self._reload_cache[1]
+                    self.telemetry.counters.inc("reload_cache_hits")
+                else:
+                    params = ckpt.load_state(path, state)["global_params"]
+                    self._reload_cache = (key, params)
+                    self.telemetry.counters.inc("reload_cache_misses")
+                state = dict(state, global_params=params)
             except FileNotFoundError:
                 pass
         rng, k_round, k_agg = jax.random.split(state["rng"], 3)
@@ -584,6 +707,13 @@ class Simulator:
         metrics["seconds"] = time.perf_counter() - t0
         self.telemetry.events.round_event(metrics)
         return new_state, metrics
+
+    def _validation_due(self, broadcast_number: int) -> bool:
+        """Validation cadence (``validation_every``), keyed on the
+        broadcast clock so the synchronous, pipelined and fused paths
+        validate the same rounds."""
+        return (self.validation is not None
+                and broadcast_number % self.cfg.validation_every == 0)
 
     def _run_plain_round(self, state, rng, k_round, k_agg, broadcast_number, metrics):
         cfg = self.cfg
@@ -612,7 +742,12 @@ class Simulator:
         defense_mask = None  # host-side filter decision (gmm/fltracer)
         if ok and cfg.mode == "gmm":
             with timer.phase("defense"):
+                # ravel dispatched ON DEVICE (jitted tree_ravel_stacked);
+                # ONE host transfer of the concatenated (C, P) matrix —
+                # the defense_transfer_bytes counter makes its cost
+                # visible in `metrics`
                 flat = np.asarray(self._ravel_stacked(stacked))
+                tel.counters.inc("defense_transfer_bytes", flat.nbytes)
                 keep = defenses.gmm_filter(flat, self.attacker_mask, seed=cfg.random_seed)
             metrics["gmm_kept"] = int(keep.sum())
             tel.counters.inc("anomalies_removed", cfg.total_clients - int(keep.sum()))
@@ -622,7 +757,9 @@ class Simulator:
             weights_mask = jnp.asarray(keep, jnp.float32)
         elif ok and cfg.mode == "fltracer":
             with timer.phase("defense"):
+                # single device->host copy, same contract as the gmm branch
                 flat = np.asarray(self._ravel_stacked(stacked))
+                tel.counters.inc("defense_transfer_bytes", flat.nbytes)
                 anomalies = defenses.fltracer_anomalies(flat)
             metrics["fltracer_anomalies"] = anomalies.tolist()
             tel.counters.inc("anomalies_removed", len(anomalies))
@@ -649,15 +786,24 @@ class Simulator:
         new_global = state["global_params"]
         if ok:
             with timer.phase("aggregate"):
+                # self.aggregate DONATES stacked (its last consumer)
                 new_global = self.aggregate(
                     state["global_params"], stacked, sizes, weights_mask, k_agg
                 )
                 jax.block_until_ready(new_global)
-            if self.validation is not None:
-                with timer.phase("validate"):
-                    val_ok, val_metrics = self.validation.test(new_global)
-                metrics.update(val_metrics)
-                ok = ok and val_ok
+            if self._validation_due(broadcast_number):
+                if cfg.validation_async:
+                    # dispatch only; the result lands one round later
+                    # (telemetry `validation` event + this entry's dict)
+                    # and does NOT gate this round's acceptance
+                    self._inflight_validations.append(
+                        (metrics, metrics["round"],
+                         self.validation.test_async(new_global)))
+                else:
+                    with timer.phase("validate"):
+                        val_ok, val_metrics = self.validation.test(new_global)
+                    metrics.update(val_metrics)
+                    ok = ok and val_ok
 
         metrics["ok"] = ok
         metrics["phases"] = timer.durations
@@ -665,12 +811,12 @@ class Simulator:
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
         # The genuine-leak cache only absorbs rounds whose *training* was
-        # clean: the reference gates accumulation on the per-client result
-        # flag (server.py:245,260-268), so a NaN round never contaminates
-        # the leak pool.  Validation-failed rounds DO leak (the reference
+        # clean (the ok-gated select now lives INSIDE round_step —
+        # training/round.py), so a NaN round never contaminates the leak
+        # pool.  Validation-failed rounds DO leak (the reference
         # re-broadcasts the already-accumulated list, server.py:596-616).
+        new_state["prev_genuine"] = new_genuine
         if train_ok:
-            new_state["prev_genuine"] = new_genuine
             new_state["have_genuine"] = np.asarray(True)
         if ok:
             new_state["global_params"] = new_global
@@ -710,7 +856,8 @@ class Simulator:
         if ok:
             with timer.phase("hyper_update"):
                 hnet_params, opt_state = self.hyper_update(
-                    # dropped clients (size 0) skip their hnet step
+                    # dropped clients (size 0) skip their hnet step;
+                    # self.hyper_update DONATES stacked (last consumer)
                     hnet_params, opt_state, stacked, active_mask * (sizes > 0)
                 )
                 jax.block_until_ready(hnet_params)
@@ -740,24 +887,31 @@ class Simulator:
                     hnet_params, opt_state = prev_hnet, prev_opt
                     gen_params = None  # rollback invalidates the generation
 
-            if self.validation is not None:
-                with timer.phase("validate"):
-                    if gen_params is None:
-                        gen_params, _ = self.generate_all(hnet_params)
-                    active_ids = jnp.asarray(np.flatnonzero(new_active > 0))
-                    val_ok, val_metrics = self.validation.test_hyper(
-                        pt.tree_take(gen_params, active_ids)
-                    )
-                metrics.update(val_metrics)
-                ok = ok and val_ok
+            if self._validation_due(broadcast_number):
+                if gen_params is None:
+                    gen_params, _ = self.generate_all(hnet_params)
+                active_ids = jnp.asarray(np.flatnonzero(new_active > 0))
+                taken = pt.tree_take(gen_params, active_ids)
+                if cfg.validation_async:
+                    # dispatch only; lands one round later and does not
+                    # gate acceptance (see _run_plain_round)
+                    self._inflight_validations.append(
+                        (metrics, metrics["round"],
+                         self.validation.test_hyper_async(taken)))
+                else:
+                    with timer.phase("validate"):
+                        val_ok, val_metrics = self.validation.test_hyper(taken)
+                    metrics.update(val_metrics)
+                    ok = ok and val_ok
 
         metrics["ok"] = ok
         metrics["phases"] = timer.durations
         new_state = dict(state)
         new_state["rng"] = rng
         new_state["broadcasts"] = np.asarray(broadcast_number)
-        if train_ok:  # NaN rounds must not contaminate the leak pool
-            new_state["prev_genuine"] = new_genuine
+        # ok-gated leak-pool select lives inside round_step (hyper.py)
+        new_state["prev_genuine"] = new_genuine
+        if train_ok:
             new_state["have_genuine"] = np.asarray(True)
         new_state["active_mask"] = new_active
         if ok:
@@ -789,7 +943,7 @@ class Simulator:
             return False
         return True
 
-    def _build_fused_body(self) -> Callable:
+    def _build_fused_body(self, include_eval: bool = True) -> Callable:
         """One broadcast as a ``lax.scan`` body over the simulation state.
 
         Collapses the reference's whole distributed round protocol — START
@@ -797,12 +951,37 @@ class Simulator:
         validation gate, accept-or-retry (server.py:205-567) — into a single
         scan step: a failed round (NaN training or failed validation) keeps
         the old params via ``where`` instead of a host-side retry branch.
+
+        ``include_eval=False`` builds the body without the validation
+        program (the pipelined executor's validation_async mode, which
+        dispatches evaluation outside the acceptance chain).  With
+        ``cfg.validation_every > 1`` the inlined evaluation is wrapped in
+        a ``lax.cond`` keyed on the broadcast clock: skipped rounds pay no
+        eval FLOPs, report NaN metrics and carry no validation gate — the
+        same cadence the per-round paths apply on host.
         """
         cfg = self.cfg
         eval_fn = None
-        if self.validation is not None:
+        if include_eval and self.validation is not None:
             eval_fn = (self.validation.eval_hyper_fn if self.is_hyper
                        else self.validation.eval_fn)
+        val_every = max(int(cfg.validation_every), 1)
+
+        def gated_eval(b, make_ev):
+            """Run ``make_ev`` when this broadcast is due for validation;
+            otherwise skip the compute entirely (NaN metrics, ok=True)."""
+            if val_every == 1:
+                return make_ev(None)
+            struct = jax.eval_shape(make_ev, None)
+
+            def skip(_):
+                return {
+                    k: (jnp.ones(s.shape, s.dtype) if k == "ok"
+                        else jnp.full(s.shape, jnp.nan, s.dtype))
+                    for k, s in struct.items()
+                }
+
+            return jax.lax.cond(b % val_every == 0, make_ev, skip, None)
 
         def accept(flag, new, old):
             return jax.tree.map(lambda n, o: jnp.where(flag, n, o), new, old)
@@ -831,8 +1010,9 @@ class Simulator:
                 ok = train_ok
                 metrics = {"train_loss": loss}
                 if eval_fn is not None:
-                    gen_params, _ = generate_all(new_hp)
-                    ev = eval_fn(stacked_params=gen_params)
+                    ev = gated_eval(
+                        b, lambda _: eval_fn(
+                            stacked_params=generate_all(new_hp)[0]))
                     ok = ok & ev.pop("ok")
                     # run_round skips validation entirely when training
                     # failed; the scan body can't skip, so mask the metrics
@@ -843,7 +1023,8 @@ class Simulator:
                 new_state = {
                     "hnet_params": accept(ok, new_hp, state["hnet_params"]),
                     "hyper_opt_state": accept(ok, new_opt, state["hyper_opt_state"]),
-                    "prev_genuine": accept(train_ok, new_gen, state["prev_genuine"]),
+                    # round_step selects the leak pool internally (ok-gated)
+                    "prev_genuine": new_gen,
                     "have_genuine": state["have_genuine"] | train_ok,
                     "active_mask": active_mask,
                     "rng": rng,
@@ -877,7 +1058,7 @@ class Simulator:
                 ok = train_ok & jnp.any(round_mask > 0)
                 metrics = {"train_loss": loss}
                 if eval_fn is not None:
-                    ev = eval_fn(params=new_global)
+                    ev = gated_eval(b, lambda _: eval_fn(params=new_global))
                     ok = ok & ev.pop("ok")
                     # mask train-failed rounds' val metrics (see hyper body)
                     metrics.update(
@@ -885,7 +1066,8 @@ class Simulator:
                     )
                 new_state = {
                     "global_params": accept(ok, new_global, state["global_params"]),
-                    "prev_genuine": accept(train_ok, new_gen, state["prev_genuine"]),
+                    # round_step selects the leak pool internally (ok-gated)
+                    "prev_genuine": new_gen,
                     "have_genuine": state["have_genuine"] | train_ok,
                     "rng": rng,
                     "completed_rounds": state["completed_rounds"] + ok.astype(jnp.int32),
@@ -1107,6 +1289,196 @@ class Simulator:
         return state, history
 
     # ------------------------------------------------------------------
+    # pipelined per-round path
+    # ------------------------------------------------------------------
+
+    def _pipeline_step_fn(self, include_eval: bool, donate: bool) -> Callable:
+        """One round as ONE jitted program (the fused scan body, unrolled
+        to a single step).  ``donate`` recycles the input state's buffers
+        in place — only legal when the caller keeps no reference to the
+        pre-round state (i.e. checkpointing is off; a checkpointed round
+        must gather the state the next dispatch would otherwise consume).
+        """
+        key = (include_eval, donate)
+        fn = self._pipeline_cache.get(key)
+        if fn is None:
+            body = self._build_fused_body(include_eval=include_eval)
+
+            def step(state):
+                return body(state, None)
+
+            fn = jax.jit(step, donate_argnums=(0,) if donate else ())
+            self._pipeline_cache[key] = fn
+        return fn
+
+    def _pipeline_executable(self, key: tuple, fn: Callable, state) -> Any:
+        """AOT-compile the pipeline step under a telemetry compile span
+        (same rationale and fallback contract as _fused_executable)."""
+        exe = self._pipeline_exe_cache.get(key)
+        if exe is None:
+            tel = self.telemetry
+            label = f"pipeline_step[eval={key[0]}]"
+            t0 = time.perf_counter()
+            try:
+                with tel.tracer.span("compile", program=label):
+                    exe = fn.lower(state).compile()
+            except Exception as e:  # noqa: BLE001 — AOT is best-effort
+                exe = False
+                tel.events.emit("compile", program=label,
+                                seconds=round(time.perf_counter() - t0, 6),
+                                error=f"{type(e).__name__}: {e}"[:300])
+            else:
+                event = {"program": label,
+                         "seconds": round(time.perf_counter() - t0, 6)}
+                memory = memory_analysis_bytes(exe)
+                if memory:
+                    event["memory_bytes"] = memory
+                tel.events.emit("compile", **event)
+            self._pipeline_exe_cache[key] = exe
+        return exe
+
+    def _resolve_pipeline_round(self, pending: dict[str, Any],
+                                round_no: int) -> dict[str, Any]:
+        """Materialize one pipelined round's metrics — the ONLY host sync
+        of the pipelined path, and it happens while the NEXT round's
+        program is already in flight on the device."""
+        host = {k: np.asarray(v) for k, v in pending["metrics"].items()}
+        entry: dict[str, Any] = {
+            k: (bool(v) if k == "ok" else float(v)) for k, v in host.items()}
+        entry["round"] = round_no
+        entry["broadcast"] = pending["broadcast"]
+        entry["pipelined"] = True
+        if pending["val"] is not None:
+            # async validation for this round was dispatched alongside the
+            # round program; by resolve time it has had a full round of
+            # device time — fold it in (no acceptance gate, by contract)
+            self._inflight_validations.append(
+                (entry, round_no, pending["val"]))
+            self._resolve_inflight_validations()
+        return entry
+
+    def _run_pipelined(
+        self,
+        num_rounds: int,
+        state: dict[str, Any],
+        save_checkpoints: bool,
+        verbose: bool,
+    ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+        """Depth-1 software-pipelined round loop (``cfg.pipeline``).
+
+        Round N's programs (train -> attack -> aggregate -> validate ->
+        accept) are dispatched as ONE jitted step whose acceptance is the
+        fused body's device-side ``where`` select — so the state round N+1
+        trains against is correct whether or not round N succeeded, and
+        the host can resolve round N's success flag one step later, while
+        round N+1 is already computing.  The rollback path IS the select:
+        a failed round keeps the previous params and advances the rng,
+        broadcast clock and genuine-leak cache exactly like the
+        synchronous retry path (parity-tested in tests/test_pipeline.py).
+
+        With checkpointing off the step DONATES the state pytree (do not
+        reuse a passed-in ``state`` afterwards — same contract as
+        run_fast); with checkpointing on the resolved round's state is
+        gathered on this thread and handed to the async writer (or written
+        synchronously without ``cfg.checkpoint_async``).
+        """
+        cfg = self.cfg
+        tel = self.telemetry
+        history: list[dict[str, Any]] = []
+        t_start = time.perf_counter()
+        self._start_monitor()
+        state = self._canonical_device_state(state)
+        # the loop's only unconditional syncs: the resume point, once
+        completed = int(state["completed_rounds"])
+        broadcast = int(state["broadcasts"])
+        include_eval = self.validation is not None and not cfg.validation_async
+        donate = not save_checkpoints
+        step = self._pipeline_step_fn(include_eval, donate)
+        pending: dict[str, Any] | None = None
+        consecutive_failures = 0
+        last_resolve = time.perf_counter()
+
+        while completed < num_rounds or pending is not None:
+            new_pending: dict[str, Any] | None = None
+            if completed + (1 if pending is not None else 0) < num_rounds:
+                broadcast += 1
+                target_round = completed + (2 if pending is not None else 1)
+                self._maybe_start_profile(target_round)
+                with tel.tracer.span("dispatch", round=target_round,
+                                     broadcast=broadcast):
+                    if tel.enabled and self.mesh is None:
+                        exe = self._pipeline_executable(
+                            (include_eval, donate), step, state)
+                    else:
+                        exe = False
+                    new_state, metrics = (
+                        exe(state) if exe is not False else step(state))
+                val = None
+                if (self.validation is not None and cfg.validation_async
+                        and broadcast % cfg.validation_every == 0):
+                    if self.is_hyper:
+                        gen_params, _ = self.generate_all(
+                            new_state["hnet_params"])
+                        val = self.validation.test_hyper_async(gen_params)
+                    else:
+                        val = self.validation.test_async(
+                            new_state["global_params"])
+                new_pending = {
+                    "metrics": metrics,
+                    "broadcast": broadcast,
+                    "val": val,
+                    # kept ONLY for checkpointing; with donation on, round
+                    # N+1's dispatch consumes these buffers
+                    "state": new_state if save_checkpoints else None,
+                }
+                state = new_state
+            if pending is not None:
+                round_no = completed + 1
+                with tel.tracer.span("resolve", round=round_no):
+                    entry = self._resolve_pipeline_round(pending, round_no)
+                now = time.perf_counter()
+                entry["seconds"] = now - last_resolve
+                last_resolve = now
+                history.append(entry)
+                tel.events.round_event(entry)
+                if self.monitor is not None:
+                    self.monitor.record_round(entry)
+                if entry["ok"]:
+                    completed += 1
+                    consecutive_failures = 0
+                    if save_checkpoints:
+                        self._save_checkpoint(pending["state"])
+                    if verbose:
+                        keys = [k for k in ("roc_auc", "accuracy", "nll",
+                                            "train_loss")
+                                if k in entry and entry[k] == entry[k]]
+                        msg = " ".join(f"{k}={entry[k]:.4f}" for k in keys)
+                        print_with_color(
+                            f"[pipeline] round {round_no} resolved in "
+                            f"{entry['seconds']:.2f}s {msg}", "green")
+                else:
+                    consecutive_failures += 1
+                    tel.counters.inc("rounds_failed")
+                    tel.counters.inc("rounds_retried")
+                    tel.events.emit("retry", round=round_no,
+                                    retries=consecutive_failures)
+                    print_with_color("Training failed!", "yellow")
+                    self.logger.log_warning(
+                        f"Round {round_no} failed "
+                        f"(retry {consecutive_failures})")
+                    if consecutive_failures > MAX_ROUND_RETRIES:
+                        self._finish_run(history, t_start)
+                        raise RuntimeError(
+                            f"Round {round_no} failed "
+                            f"{consecutive_failures} times; aborting (the "
+                            "reference would retry forever, "
+                            "server.py:546-556)")
+                self._maybe_stop_profile(completed)
+            pending = new_pending
+        self._finish_run(history, t_start)
+        return state, history
+
+    # ------------------------------------------------------------------
     # full run
     # ------------------------------------------------------------------
 
@@ -1116,13 +1488,30 @@ class Simulator:
         state: dict[str, Any] | None = None,
         save_checkpoints: bool = True,
         verbose: bool = True,
+        pipeline: bool | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
         """Run until ``num_rounds`` rounds complete (reference main loop,
-        server.py:559-567)."""
+        server.py:559-567).
+
+        ``pipeline`` (default: ``cfg.pipeline``) routes through the
+        depth-1 software-pipelined executor (:meth:`_run_pipelined`) —
+        same final params and per-round ``ok`` sequence as the synchronous
+        path, with round N+1 dispatched before round N's flag is
+        materialized.  Host-side-defense modes (gmm / fltracer,
+        hyper-detection, reload-per-round) fall back to the synchronous
+        loop with a warning."""
         cfg = self.cfg
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
         state = state if state is not None else self.load_or_init_state()
         self._emit_run_header()
+        use_pipeline = cfg.pipeline if pipeline is None else pipeline
+        if use_pipeline:
+            if self.supports_fused():
+                return self._run_pipelined(num_rounds, state,
+                                           save_checkpoints, verbose)
+            print_with_color(
+                f"[pipeline] mode '{cfg.mode}' needs host-side per-round "
+                "work; falling back to the synchronous path.", "yellow")
         history: list[dict[str, Any]] = []
         retries = 0
         t_start = time.perf_counter()

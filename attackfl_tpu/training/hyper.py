@@ -168,21 +168,24 @@ def build_hyper_round(
             stacked = jax.tree.map(scatter, stacked, attacked)
             ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
 
-        fresh = pt.tree_take(stacked, genuine_arr)
-        if drop_rate > 0:
-            # dropped genuine clients keep their last REPORTED update in
-            # the leak pool (see training/round.py round_step)
-            sel = kept[genuine_arr] | ~have_genuine
-            new_genuine = jax.tree.map(
-                lambda n, p: jnp.where(
-                    sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
-                fresh, prev_genuine,
-            )
-        else:
-            new_genuine = fresh
         ok = jnp.all(ok | ~active_mask.astype(bool))
         participating = active_mask * kept.astype(active_mask.dtype)
         ok = ok & (jnp.sum(participating) > 0)
+        fresh = pt.tree_take(stacked, genuine_arr)
+        # ok-gated leak-pool select inside the program (donation-safe
+        # contract — see training/round.py round_step): a failed round's
+        # returned tree already keeps the previous pool.
+        if drop_rate > 0:
+            # dropped genuine clients keep their last REPORTED update in
+            # the leak pool (see training/round.py round_step)
+            sel = ok & (kept[genuine_arr] | ~have_genuine)
+        else:
+            sel = jnp.broadcast_to(ok, (num_genuine,))
+        new_genuine = jax.tree.map(
+            lambda n, p: jnp.where(
+                sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
+            fresh, prev_genuine,
+        )
         loss = jnp.sum(losses * participating) / jnp.maximum(jnp.sum(participating), 1.0)
         return stacked, sizes, new_genuine, ok, loss
 

@@ -265,7 +265,15 @@ def build_round_step(
             # attackers that attacked did not train; their NaN status resets
             ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
 
+        # a round where every client drops has no updates at all — fail it
+        # (the reference analog is a barrier deadlock, server.py:271-272)
+        train_ok = jnp.all(ok) & jnp.any(kept)
         fresh = pt.tree_take(stacked, genuine_arr)
+        # The genuine-leak pool only absorbs rounds whose training was
+        # clean: the reference gates accumulation on the per-client result
+        # flag (server.py:245,260-268).  Selecting INSIDE the program (vs
+        # on host) keeps the returned tree correct on failed rounds too, so
+        # callers may treat ``prev_genuine`` as consumed (donation-safe).
         if drop_rate > 0:
             # Dropped genuine clients never report, so their last REPORTED
             # update stays in the leak pool (stale) — the reference
@@ -273,19 +281,17 @@ def build_round_step(
             # (server.py:259-268).  Until a client has reported once
             # (~have_genuine: the pool rows are still init placeholders)
             # its fresh no-op row is used instead.
-            sel = kept[genuine_arr] | ~have_genuine
-            new_genuine = jax.tree.map(
-                lambda n, p: jnp.where(
-                    sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
-                fresh, prev_genuine,
-            )
+            sel = train_ok & (kept[genuine_arr] | ~have_genuine)
         else:
-            new_genuine = fresh
+            sel = jnp.broadcast_to(train_ok, (num_genuine,))
+        new_genuine = jax.tree.map(
+            lambda n, p: jnp.where(
+                sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
+            fresh, prev_genuine,
+        )
         keptf = kept.astype(losses.dtype)
         mean_loss = jnp.sum(losses * keptf) / jnp.maximum(jnp.sum(keptf), 1.0)
-        # a round where every client drops has no updates at all — fail it
-        # (the reference analog is a barrier deadlock, server.py:271-272)
-        return stacked, sizes, new_genuine, jnp.all(ok) & jnp.any(kept), mean_loss
+        return stacked, sizes, new_genuine, train_ok, mean_loss
 
     # host-side program metadata for the telemetry run header (never read
     # inside the traced function)

@@ -12,7 +12,8 @@ structurally matching template (same config), like torch load_state_dict.
 from __future__ import annotations
 
 import os
-from typing import Any
+import threading
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -29,13 +30,117 @@ def _strip_keys(tree: Any) -> Any:
     return jax.tree.map(lambda x: jax.random.key_data(x) if _is_key(x) else x, tree)
 
 
-def save_state(path: str, state: Any) -> None:
-    state = jax.device_get(_strip_keys(state))
-    data = serialization.to_bytes(state)
-    tmp = path + ".tmp"
+def host_state(state: Any) -> Any:
+    """Device state -> host numpy tree ready for serialization.  This is
+    the device->host gather half of a checkpoint: it stays on the caller
+    (the round loop) while :class:`AsyncCheckpointWriter` takes the
+    serialize + write + fsync half off the critical path."""
+    return jax.device_get(_strip_keys(state))
+
+
+def _write_bytes(path: str, data: bytes, tmp_suffix: str = ".tmp") -> None:
+    """Durable atomic publish: write a temp file, fsync it, rename."""
+    tmp = path + tmp_suffix
     with open(tmp, "wb") as fh:
         fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path)
+
+
+def save_state(path: str, state: Any) -> None:
+    _write_bytes(path, serialization.to_bytes(host_state(state)))
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint persistence with last-write-wins coalescing.
+
+    The round loop calls :meth:`submit` with an already-gathered host tree
+    (see :func:`host_state`); msgpack serialization, the file write and the
+    fsync all happen on one daemon thread.  The pending slot is a bounded
+    queue of depth 1: submitting while a write is queued replaces the
+    queued state (checkpoints are full-state snapshots, so only the newest
+    matters — the skipped write is counted, not lost semantically).
+    :meth:`drain` blocks until everything submitted so far is durably on
+    disk; :meth:`close` drains and stops the thread, guaranteeing the
+    final submitted state is flushed.  A write error is re-raised on the
+    next submit/drain/close so a dying disk can't fail silently.
+    """
+
+    def __init__(self, on_write: Callable[[str], None] | None = None):
+        self._cond = threading.Condition()
+        self._pending: tuple[str, Any] | None = None
+        self._writing = False
+        self._closed = False
+        self._error: BaseException | None = None
+        self._on_write = on_write
+        self.writes_completed = 0
+        self.writes_coalesced = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="attackfl-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None and self._closed:
+                    return
+                path, state = self._pending
+                self._pending = None
+                self._writing = True
+            try:
+                # distinct temp suffix: a concurrent synchronous
+                # save_state to the same path must not clobber our temp
+                _write_bytes(path, serialization.to_bytes(state),
+                             tmp_suffix=f".tmp.async{id(self):x}")
+            except BaseException as e:  # noqa: BLE001 — surfaced on next call
+                with self._cond:
+                    self._error = e
+                    self._writing = False
+                    self._cond.notify_all()
+                continue
+            with self._cond:
+                self.writes_completed += 1
+                self._writing = False
+                self._cond.notify_all()
+            if self._on_write is not None:
+                self._on_write(path)
+
+    def _check_error(self) -> None:
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from error
+
+    def submit(self, path: str, state: Any) -> None:
+        """Queue ``state`` (a host tree from :func:`host_state`) for
+        persistence to ``path``.  Returns immediately."""
+        with self._cond:
+            self._check_error()
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._pending is not None:
+                self.writes_coalesced += 1
+            self._pending = (path, state)
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        """Block until every submitted state is durably written."""
+        with self._cond:
+            while self._pending is not None or self._writing:
+                self._cond.wait()
+            self._check_error()
+
+    def close(self) -> None:
+        """Drain and stop the writer thread.  Safe to call twice."""
+        with self._cond:
+            if self._closed and not self._thread.is_alive():
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join()
+        self._check_error()
 
 
 def load_state(path: str, template: Any) -> Any:

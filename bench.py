@@ -240,6 +240,129 @@ def measure(cfg, n_rounds: int, metric_keys=("roc_auc", "accuracy", "nll"),
     return out
 
 
+def pipeline_compare_config(log_path: str = "/tmp/attackfl_bench"):
+    """Workload for --pipeline-compare: a checkpoint-heavy round (192
+    clients -> a ~37 MB state: the genuine-leak pool scales with C x P)
+    with modest per-round device compute, so the synchronous path's host
+    overheads (per-phase sync barriers, validation blocking, checkpoint
+    serialize+write+fsync every round) are a visible fraction of the round
+    — exactly the costs the pipelined executor takes off the critical
+    path.  On a single-core CPU box the async win is mostly last-write-
+    wins coalescing (the writer skips intermediate snapshots under load);
+    with free cores the serialize+write overlaps device compute as well."""
+    from attackfl_tpu.config import Config
+
+    return Config(
+        num_round=30, total_clients=192, mode="fedavg",
+        model="TransformerModel", data_name="ICU",
+        num_data_range=(32, 64), epochs=1, batch_size=64,
+        train_size=2048, test_size=256, validation=True,
+        log_path=log_path, checkpoint_dir=log_path,
+    )
+
+
+def measure_pipeline_compare(rounds: int, log_path: str,
+                             reps: int = 3) -> dict:
+    """Steady-state rounds/s: synchronous run() with per-round synchronous
+    checkpointing (the default) vs run(pipeline=True) with the async
+    checkpoint writer, on the SAME config.
+
+    Each variant warms its programs once (untimed round), then the two
+    variants run INTERLEAVED `reps` times and the best rate per variant is
+    reported — on a loaded single-core box a single short window is noise
+    (background load swings a 2 s measurement by 30%); interleaving
+    cancels drift and best-of discards the windows a noisy neighbor ate.
+    Per-rep rates are included in the detail for honesty."""
+    import os
+
+    from attackfl_tpu.training.engine import Simulator
+
+    os.makedirs(log_path, exist_ok=True)
+    base = pipeline_compare_config(log_path)
+    out: dict = {"config": "pipeline-compare: 192 clients ICU Transformer, "
+                           "validation on, per-round checkpoints",
+                 "timed_rounds_per_rep": rounds, "reps": reps}
+
+    def make(cfg, pipeline: bool):
+        sim = Simulator(cfg)
+        # warmup: compile every program on this path
+        sim.run(num_rounds=1, state=sim.init_state(),
+                save_checkpoints=True, verbose=False, pipeline=pipeline)
+        return sim
+
+    def timed_rep(sim, pipeline: bool) -> float:
+        state = sim.init_state()
+        t0 = time.perf_counter()
+        _, hist = sim.run(num_rounds=rounds, state=state,
+                          save_checkpoints=True, verbose=False,
+                          pipeline=pipeline)
+        return len(hist) / (time.perf_counter() - t0)
+
+    sync_sim = make(base, pipeline=False)
+    pipe_sim = make(base.replace(pipeline=True, checkpoint_async=True),
+                    pipeline=True)
+    sync_rates, pipe_rates = [], []
+    for _ in range(reps):
+        sync_rates.append(round(timed_rep(sync_sim, False), 4))
+        pipe_rates.append(round(timed_rep(pipe_sim, True), 4))
+    sync_sim.close()
+    pipe_sim.close()
+
+    out["sync"] = {"rounds_per_sec_steady": max(sync_rates),
+                   "per_rep": sync_rates}
+    out["pipelined_async_ckpt"] = {"rounds_per_sec_steady": max(pipe_rates),
+                                   "per_rep": pipe_rates}
+    out["speedup"] = round(max(pipe_rates) / max(sync_rates), 4)
+    return out
+
+
+def measure_compile_cache(cfg, n_rounds: int, cache_dir: str) -> dict:
+    """First-run vs warm-cache compile cost of the fused round program.
+
+    Enables the persistent compilation cache, compiles + runs the scan
+    once (cold unless the cache dir is already warm), then drops the
+    in-process jit caches (jax.clear_caches) and compiles again through a
+    FRESH Simulator — the second compile must be served from the on-disk
+    cache, standing in for a process restart."""
+    import jax
+
+    from attackfl_tpu.telemetry.xla import (compile_cache_stats,
+                                            enable_compile_cache)
+    from attackfl_tpu.training.engine import Simulator
+
+    enable_compile_cache(cache_dir)
+
+    def one_pass() -> dict:
+        before = compile_cache_stats()
+        sim = Simulator(cfg)
+        state = sim.init_state()
+        t0 = time.perf_counter()
+        state, metrics = sim.run_scan(state, n_rounds)
+        jax.block_until_ready(metrics)
+        total = time.perf_counter() - t0
+        sim.close()
+        after = compile_cache_stats()
+        return {
+            "compile_plus_run_s": round(total, 3),
+            "backend_compile_s": round(
+                after["backend_compile_seconds"]
+                - before["backend_compile_seconds"], 3),
+            "cache_retrieval_s": round(
+                after["cache_retrieval_seconds"]
+                - before["cache_retrieval_seconds"], 3),
+            "cache_hits": after["cache_hits"] - before["cache_hits"],
+            "cache_misses": after["cache_misses"] - before["cache_misses"],
+        }
+
+    cold = one_pass()
+    jax.clear_caches()  # drop in-memory jit caches; disk cache survives
+    warm = one_pass()
+    return {"cache_dir": cache_dir, "rounds": n_rounds,
+            "first_run": cold, "warm_cache": warm,
+            "compile_seconds_saved": round(
+                cold["backend_compile_s"] - warm["backend_compile_s"], 3)}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", type=int, default=None,
@@ -269,19 +392,34 @@ def main() -> None:
     parser.add_argument("--trace", type=str, default=None,
                         help="capture a jax.profiler trace of the timed "
                              "section into this directory (single-row mode)")
+    parser.add_argument("--pipeline-compare", action="store_true",
+                        help="measure ONLY steady-state rounds/s of the "
+                             "synchronous default vs pipeline=True + async "
+                             "checkpointing on the same config")
+    parser.add_argument("--compile-cache", nargs="?", type=str, default=None,
+                        const="/tmp/attackfl_compile_cache", metavar="DIR",
+                        help="measure ONLY first-run vs warm-cache compile "
+                             "seconds of the fused round program "
+                             "(persistent compilation cache in DIR; "
+                             "composes with --config/--clients/--rounds; "
+                             "default workload: BASELINE config 1)")
     args = parser.parse_args()
 
-    if sum(map(bool, (args.config is not None, args.north_star,
-                      args.e2e_rounds is not None))) > 1:
-        parser.error("--config / --north-star / --e2e-rounds are exclusive")
+    if sum(map(bool, (args.config is not None and args.compile_cache is None,
+                      args.north_star, args.e2e_rounds is not None,
+                      args.pipeline_compare,
+                      args.compile_cache is not None))) > 1:
+        parser.error("--config / --north-star / --e2e-rounds / "
+                     "--pipeline-compare / --compile-cache are exclusive")
     single = (args.config is not None or args.north_star
-              or args.e2e_rounds is not None)
+              or args.e2e_rounds is not None or args.pipeline_compare
+              or args.compile_cache is not None)
     if not single and (args.backend or args.clients or args.trace or args.dtype
                        or args.hyper_update):
         parser.error("--backend/--clients/--dtype/--hyper-update/--trace "
                      "apply to a single measurement; add --config N / "
                      "--north-star / --e2e-rounds")
-    if args.clients and args.config is None:
+    if args.clients and args.config is None and args.compile_cache is None:
         parser.error("--clients applies to --config rows")
     if args.hyper_update and args.config != 2:
         parser.error("--hyper-update applies to --config 2 (hyper mode)")
@@ -291,6 +429,10 @@ def main() -> None:
 
     if args.north_star:
         metric_name = "fl_rounds_per_sec_1000c"
+    elif args.pipeline_compare:
+        metric_name = "fl_pipeline_vs_sync_rounds_per_sec"
+    elif args.compile_cache is not None:
+        metric_name = "fl_compile_cache_warm_vs_cold_s"
     elif args.e2e_rounds is not None:
         metric_name = f"fl_e2e_{args.e2e_rounds}_rounds_per_sec"
     elif args.config is not None:
@@ -363,6 +505,43 @@ def main() -> None:
             **{vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4)},
             detail=res,
         )))
+
+    if args.pipeline_compare:
+        deadline_timer.cancel()
+        res = measure_pipeline_compare(args.rounds, "/tmp/attackfl_bench")
+        partial.update(res)
+        print(json.dumps(metric_line(
+            metric_name, res["pipelined_async_ckpt"]["rounds_per_sec_steady"],
+            unit="rounds/s",
+            vs_sync=res["speedup"],
+            detail=res,
+        )))
+        return
+
+    if args.compile_cache is not None:
+        # default workload: BASELINE config 1 with shrunk per-round data —
+        # the object of measurement is COMPILE seconds (the program is the
+        # same scan body; data sizes only stretch the timed run portion,
+        # which on a CPU box would dwarf the compile split being proven)
+        if args.config is not None:
+            cfg = make_config(args.config)
+        else:
+            cfg = make_config(1).replace(
+                num_data_range=(256, 512), train_size=4096, test_size=1024)
+        if args.clients:
+            cfg = cfg.replace(total_clients=args.clients)
+        if args.backend:
+            cfg = cfg.replace(local_backend=args.backend)
+        if args.dtype:
+            cfg = _with_dtype(cfg, args.dtype)
+        res = measure_compile_cache(cfg, max(args.rounds, 2), args.compile_cache)
+        deadline_timer.cancel()
+        print(json.dumps(metric_line(
+            metric_name, res["warm_cache"]["backend_compile_s"], unit="s",
+            cold_backend_compile_s=res["first_run"]["backend_compile_s"],
+            detail=res,
+        )))
+        return
 
     if args.north_star:  # 1000-client row (BASELINE.json target workload)
         cfg = north_star_config()

@@ -1,0 +1,125 @@
+"""Async checkpoint writer (ISSUE 3): ordering under rapid rounds,
+last-write-wins coalescing, the drain-on-close guarantee, and
+bit-identical resume vs the synchronous writer."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+from flax import serialization
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.training.engine import Simulator
+from attackfl_tpu.utils import checkpoint as ckpt
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128,
+)
+
+
+def _load_tree(path, template):
+    with open(path, "rb") as fh:
+        return serialization.from_bytes(template, fh.read())
+
+
+def test_ordering_under_rapid_submits(tmp_path):
+    """Many rapid submits: the file always ends at the NEWEST state (the
+    writer may skip intermediates, never reorder past the last)."""
+    writer = ckpt.AsyncCheckpointWriter()
+    path = str(tmp_path / "state.msgpack")
+    for i in range(50):
+        writer.submit(path, {"step": np.asarray(i)})
+    writer.drain()
+    assert _load_tree(path, {"step": np.asarray(0)})["step"] == 49
+    assert writer.writes_completed >= 1
+    writer.close()
+
+
+def test_last_write_wins_coalescing(tmp_path, monkeypatch):
+    """With the writer stalled, queued submits coalesce to the newest
+    state — bounded queue, no backlog growth."""
+    real = serialization.to_bytes
+
+    def slow_to_bytes(tree):
+        time.sleep(0.05)
+        return real(tree)
+
+    monkeypatch.setattr(ckpt.serialization, "to_bytes", slow_to_bytes)
+    writer = ckpt.AsyncCheckpointWriter()
+    path = str(tmp_path / "state.msgpack")
+    n = 20
+    for i in range(n):
+        writer.submit(path, {"step": np.asarray(i)})
+    writer.drain()
+    assert _load_tree(path, {"step": np.asarray(0)})["step"] == n - 1
+    assert writer.writes_coalesced > 0
+    assert writer.writes_completed + writer.writes_coalesced == n
+    assert writer.writes_completed < n
+    writer.close()
+
+
+def test_drain_on_close_flushes_final_state(tmp_path):
+    writer = ckpt.AsyncCheckpointWriter()
+    path = str(tmp_path / "state.msgpack")
+    writer.submit(path, {"step": np.asarray(7)})
+    writer.close()  # must not drop the queued write
+    assert _load_tree(path, {"step": np.asarray(0)})["step"] == 7
+    writer.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        writer.submit(path, {"step": np.asarray(8)})
+
+
+def test_write_error_surfaces(tmp_path):
+    writer = ckpt.AsyncCheckpointWriter()
+    bad = str(tmp_path / "no_such_dir" / "state.msgpack")
+    writer.submit(bad, {"step": np.asarray(0)})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        writer.drain()
+    writer.close()
+
+
+def test_async_checkpoint_bit_identical_and_resume(tmp_path):
+    """An async-written checkpoint must be byte-identical to a sync-written
+    one from the same run, and a resumed run from it must match a resume
+    from the sync file exactly."""
+    sync_dir, async_dir = tmp_path / "sync", tmp_path / "async"
+    sync_dir.mkdir(), async_dir.mkdir()
+
+    def run(dir_, checkpoint_async):
+        cfg = Config(num_round=2, total_clients=3, mode="fedavg",
+                     checkpoint_async=checkpoint_async, log_path=str(dir_),
+                     checkpoint_dir=str(dir_), **BASE)
+        sim = Simulator(cfg)
+        state, _ = sim.run(save_checkpoints=True, verbose=False)
+        sim.close()  # drains the writer
+        return cfg, state
+
+    cfg_s, _ = run(sync_dir, False)
+    cfg_a, _ = run(async_dir, True)
+    sync_bytes = open(ckpt.checkpoint_path(cfg_s), "rb").read()
+    async_bytes = open(ckpt.checkpoint_path(cfg_a), "rb").read()
+    assert sync_bytes == async_bytes
+
+    # resume both: identical state trees
+    res_s = Simulator(cfg_s.replace(load_parameters=True)).load_or_init_state()
+    res_a = Simulator(cfg_a.replace(load_parameters=True)).load_or_init_state()
+    assert int(res_a["completed_rounds"]) == 2
+    for a, b in zip(jax.tree.leaves(ckpt.host_state(res_s)),
+                    jax.tree.leaves(ckpt.host_state(res_a))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_run_drains_writer_before_returning(tmp_path):
+    """run() must leave the FINAL state durably on disk (drain in
+    _finish_run), not just enqueued."""
+    cfg = Config(num_round=3, total_clients=3, mode="fedavg",
+                 checkpoint_async=True, log_path=str(tmp_path),
+                 checkpoint_dir=str(tmp_path), **BASE)
+    sim = Simulator(cfg)
+    state, _ = sim.run(save_checkpoints=True, verbose=False)
+    loaded = ckpt.load_state(ckpt.checkpoint_path(cfg), sim.init_state())
+    assert int(loaded["completed_rounds"]) == int(state["completed_rounds"]) == 3
+    assert sim.telemetry.counters.get("checkpoint_submits") == 3
+    sim.close()

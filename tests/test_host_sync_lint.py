@@ -1,0 +1,47 @@
+"""Tier-1 wiring for scripts/check_host_sync.py (ISSUE 3 satellite): the
+training hot path must not grow new host-device sync barriers
+(block_until_ready / float / np.asarray on device values) outside the
+audited allowlist — the pipelined executor's throughput depends on it."""
+
+import importlib.util
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_host_sync", REPO / "scripts" / "check_host_sync.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_training_hot_path_has_no_unaudited_syncs(capsys):
+    lint = load_lint()
+    assert lint.main([]) == 0, capsys.readouterr().out
+
+
+def test_lint_catches_a_new_sync(tmp_path):
+    """The lint actually fires: an un-allowlisted float()/np.asarray/
+    block_until_ready call in a training module is reported."""
+    lint = load_lint()
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def hot_loop(x):\n"
+        "    y = float(x)\n"
+        "    z = np.asarray(x)\n"
+        "    x.block_until_ready()\n"
+        "    return y, z\n"
+    )
+    violations = lint.check_file(bad)
+    assert len(violations) == 3
+    assert any("float" in v for v in violations)
+    assert any("np.asarray" in v for v in violations)
+    assert any("block_until_ready" in v for v in violations)
+
+    # an audited function stays green
+    ok = tmp_path / "round.py"
+    ok.write_text("def build_round_step(m):\n    return float(m)\n")
+    assert lint.check_file(ok) == []

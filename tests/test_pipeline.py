@@ -1,0 +1,252 @@
+"""Pipelined round executor (ISSUE 3): parity with the synchronous path
+(final params, per-round ok flags, rollback on an injected failed round),
+validation scheduling (validation_every / validation_async), the
+persistent compile cache hookup, and the reload mtime cache."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.training.engine import Simulator
+from attackfl_tpu.utils import checkpoint as ckpt
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128, log_path=".",
+    checkpoint_dir=".",
+)
+
+
+def _assert_state_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _poison_broadcast(sim, bad_broadcast: int) -> None:
+    """Force the round dispatched at ``bad_broadcast`` to fail training
+    (NaN loss, ok=False) — identical wrapping for both executors, so the
+    rollback/retry trajectories stay comparable."""
+    inner = sim._round_step_raw
+
+    def wrapped(global_params, prev_genuine, have_genuine, rng, broadcast_number):
+        stacked, sizes, new_genuine, ok, loss = inner(
+            global_params, prev_genuine, have_genuine, rng, broadcast_number)
+        fail = broadcast_number == bad_broadcast
+        return (stacked, sizes, new_genuine, ok & ~fail,
+                jnp.where(fail, jnp.nan, loss))
+
+    wrapped.telemetry_info = getattr(inner, "telemetry_info", None)
+    sim._round_step_raw = wrapped
+    sim.round_step = jax.jit(wrapped)
+
+
+def test_pipeline_matches_sync_5_rounds():
+    """Seeded 5-round config: same per-round ok flags and bit-identical
+    final params on both executors."""
+    cfg = Config(num_round=5, total_clients=5, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                     attack_round=3),),
+                 **BASE)
+    state_s, hist_s = Simulator(cfg).run(save_checkpoints=False,
+                                         verbose=False, pipeline=False)
+    state_p, hist_p = Simulator(cfg).run(save_checkpoints=False,
+                                         verbose=False, pipeline=True)
+    assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p] == [True] * 5
+    assert all(h.get("pipelined") for h in hist_p)
+    assert int(state_p["completed_rounds"]) == 5
+    assert int(state_p["broadcasts"]) == int(state_s["broadcasts"])
+    _assert_state_equal(state_s["global_params"], state_p["global_params"])
+    _assert_state_equal(state_s["prev_genuine"], state_p["prev_genuine"])
+
+
+def test_pipeline_rollback_on_injected_nan_round():
+    """An injected train failure at broadcast 3: both executors record the
+    failed attempt, keep the pre-failure params (rollback), retry on the
+    next broadcast and converge to identical final state."""
+    cfg = Config(num_round=5, total_clients=4, mode="fedavg", **BASE)
+    sim_s, sim_p = Simulator(cfg), Simulator(cfg)
+    _poison_broadcast(sim_s, 3)
+    _poison_broadcast(sim_p, 3)
+    state_s, hist_s = sim_s.run(save_checkpoints=False, verbose=False,
+                                pipeline=False)
+    state_p, hist_p = sim_p.run(save_checkpoints=False, verbose=False,
+                                pipeline=True)
+    oks = [h["ok"] for h in hist_s]
+    assert oks == [h["ok"] for h in hist_p]
+    assert oks == [True, True, False, True, True, True]
+    # the failed attempt kept round number 3 on both paths
+    assert hist_s[2]["round"] == hist_p[2]["round"] == 3
+    assert int(state_p["completed_rounds"]) == 5
+    assert int(state_p["broadcasts"]) == 6  # retry advanced the clock
+    _assert_state_equal(state_s["global_params"], state_p["global_params"])
+
+
+def test_pipeline_checkpoints_and_resume(tmp_path):
+    """Pipelined run with (async) checkpointing resumes exactly like a
+    synchronous run's checkpoint."""
+    base = dict(BASE, log_path=str(tmp_path), checkpoint_dir=str(tmp_path))
+    cfg = Config(num_round=3, total_clients=3, mode="fedavg",
+                 pipeline=True, checkpoint_async=True, **base)
+    sim = Simulator(cfg)
+    state, hist = sim.run(save_checkpoints=True, verbose=False)
+    sim.close()
+    assert [h["ok"] for h in hist] == [True] * 3
+    resumed = Simulator(cfg.replace(load_parameters=True)).load_or_init_state()
+    assert int(resumed["completed_rounds"]) == 3
+    _assert_state_equal(resumed["global_params"], state["global_params"])
+
+
+def test_pipeline_falls_back_for_host_side_modes():
+    cfg = Config(num_round=1, total_clients=4, mode="gmm", **BASE)
+    sim = Simulator(cfg)
+    _, hist = sim.run(save_checkpoints=False, verbose=False, pipeline=True)
+    assert len(hist) == 1 and not hist[0].get("pipelined")
+
+
+def test_pipeline_hyper_mode():
+    cfg = Config(num_round=2, total_clients=3, mode="hyper", **BASE)
+    state_s, hist_s = Simulator(cfg).run(save_checkpoints=False,
+                                         verbose=False, pipeline=False)
+    state_p, hist_p = Simulator(cfg).run(save_checkpoints=False,
+                                         verbose=False, pipeline=True)
+    assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p] == [True] * 2
+    _assert_state_equal(state_s["hnet_params"], state_p["hnet_params"])
+
+
+# ---------------------------------------------------------------------------
+# validation scheduling
+# ---------------------------------------------------------------------------
+
+
+def test_validation_every_skips_rounds_on_all_paths():
+    """validation_every=2: only even broadcasts carry validation metrics,
+    on the synchronous, pipelined and fused paths alike."""
+    cfg = Config(num_round=4, total_clients=3, mode="fedavg",
+                 validation_every=2, **BASE)
+    _, hist_s = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert [("roc_auc" in h) for h in hist_s] == [False, True, False, True]
+
+    _, hist_p = Simulator(cfg).run(save_checkpoints=False, verbose=False,
+                                   pipeline=True)
+    assert [h["ok"] for h in hist_p] == [True] * 4
+    # skipped rounds report NaN metrics on the one-program paths
+    aucs = [h.get("roc_auc", float("nan")) for h in hist_p]
+    assert [a == a for a in aucs] == [False, True, False, True]
+
+    sim_f = Simulator(cfg)
+    _, metrics = sim_f.run_scan(sim_f.init_state(), 4)
+    auc = np.asarray(metrics["roc_auc"])
+    assert list(np.isfinite(auc)) == [False, True, False, True]
+    # validated rounds agree across paths
+    np.testing.assert_allclose(auc[1], hist_s[1]["roc_auc"], atol=1e-5)
+    np.testing.assert_allclose(auc[3], hist_s[3]["roc_auc"], atol=1e-5)
+
+
+def test_validation_async_folds_results_in(tmp_path, monkeypatch):
+    """validation_async: results land in the history entries and as
+    telemetry `validation` events after the fact; the round is accepted
+    without waiting on the verdict."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = Config(num_round=3, total_clients=3, mode="fedavg",
+                 validation_async=True, **BASE)
+    sim = Simulator(cfg)
+    _, hist = sim.run(save_checkpoints=False, verbose=False)
+    sim.close()
+    assert [h["ok"] for h in hist] == [True] * 3
+    assert all("roc_auc" in h and "validation_ok" in h for h in hist)
+    events = [json.loads(line) for line in
+              open(os.path.join(str(tmp_path), "events.jsonl"))]
+    val = [e for e in events if e["kind"] == "validation"]
+    assert [e["round"] for e in val] == [1, 2, 3]
+    assert all(e["background"] and "roc_auc" in e for e in val)
+
+
+def test_validation_async_pipeline_matches_params():
+    """Async validation never changes the trained params (it is outside
+    the acceptance chain) — pipelined async run matches the sync run with
+    validation disabled, param-for-param."""
+    cfg = Config(num_round=3, total_clients=3, mode="fedavg", **BASE)
+    ref, _ = Simulator(cfg.replace(validation=False)).run(
+        save_checkpoints=False, verbose=False)
+    got, hist = Simulator(cfg.replace(validation_async=True)).run(
+        save_checkpoints=False, verbose=False, pipeline=True)
+    assert all("roc_auc" in h for h in hist)
+    _assert_state_equal(ref["global_params"], got["global_params"])
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache + reload mtime cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _restore_compile_cache_config():
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
+def test_compile_cache_env_override(tmp_path, monkeypatch,
+                                    _restore_compile_cache_config):
+    """ATTACKFL_COMPILE_CACHE points jax at a persistent cache dir; the
+    run header records it and a `compile` stats event lands at run end."""
+    cache_dir = tmp_path / "cache"
+    tel_dir = tmp_path / "tel"
+    monkeypatch.setenv("ATTACKFL_COMPILE_CACHE", str(cache_dir))
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tel_dir))
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg",
+                 validation=False, **BASE)
+    sim = Simulator(cfg)
+    assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+    sim.run(save_checkpoints=False, verbose=False)
+    sim.close()
+    assert os.listdir(cache_dir)  # programs were persisted
+    events = [json.loads(line) for line in
+              open(os.path.join(str(tel_dir), "events.jsonl"))]
+    header = next(e for e in events if e["kind"] == "run_header")
+    assert header["compile_cache_dir"] == str(cache_dir)
+    stats = [e for e in events if e["kind"] == "compile"
+             and e.get("program") == "persistent_cache"]
+    assert len(stats) == 1
+    assert stats[0]["cache_misses"] >= 1  # cold dir: first compile missed
+    assert stats[0]["seconds"] > 0
+
+
+def test_reload_params_mtime_cache(tmp_path, monkeypatch):
+    """reload_parameters_per_round: an unchanged checkpoint file costs a
+    stat, not a deserialize — and a changed file is re-read."""
+    base = dict(BASE, log_path=str(tmp_path), checkpoint_dir=str(tmp_path))
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg", **base)
+    sim0 = Simulator(cfg)
+    sim0.run(save_checkpoints=True, verbose=False)
+
+    calls = []
+    real = ckpt.load_state
+
+    def counting(path, template):
+        calls.append(path)
+        return real(path, template)
+
+    monkeypatch.setattr(ckpt, "load_state", counting)
+    reload_cfg = cfg.replace(num_round=3, load_parameters=True,
+                             reload_parameters_per_round=True)
+    sim = Simulator(reload_cfg)
+    state = sim.load_or_init_state()
+    n0 = len(calls)
+    state, _ = sim.run_round(state)
+    state, _ = sim.run_round(state)
+    assert len(calls) == n0 + 1  # second round: cache hit, no deserialize
+    assert sim.telemetry.counters.get("reload_cache_hits") == 1
+    # touching the file invalidates the cache
+    path = ckpt.checkpoint_path(reload_cfg)
+    os.utime(path, ns=(os.stat(path).st_atime_ns,
+                       os.stat(path).st_mtime_ns + 1_000_000))
+    state, _ = sim.run_round(state)
+    assert len(calls) == n0 + 2
