@@ -168,6 +168,14 @@ def server_main(argv=None) -> None:
                         metavar="A:B",
                         help="wrap rounds A..B in jax.profiler device "
                              "tracing (output: <telemetry dir>/profile)")
+    parser.add_argument("--numerics", action="store_true",
+                        help="in-graph numerics engine: device-side "
+                             "per-round metric rows (update-norm "
+                             "distributions, attack separation, drift, "
+                             "non-finite provenance) drained late as "
+                             "schema-v3 metric events "
+                             "(telemetry.numerics; report with "
+                             "`attackfl-tpu metrics --numerics`)")
     # --- multi-host (DCN) scale-out: one process per host, same command
     # with a distinct --process-id (parallel/mesh.distributed_init) ---
     parser.add_argument("--coordinator", type=str, default=None,
@@ -209,6 +217,8 @@ def server_main(argv=None) -> None:
         overrides["monitor_port"] = args.monitor_port
     if args.profile_rounds is not None:
         overrides["profile_rounds"] = args.profile_rounds
+    if args.numerics:
+        overrides["numerics"] = True
     if overrides:
         cfg = cfg.replace(
             telemetry=dataclasses.replace(cfg.telemetry, **overrides))
@@ -322,6 +332,18 @@ def watch_main(argv=None) -> int:
             keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss")
                     if isinstance(last.get(k), (int, float))]
             msg = " ".join(f"{k}={last[k]:.4f}" for k in keys)
+            # latest drained numerics gauges (--numerics runs): shown next
+            # to the round line so a drifting p95 / a non-finite count / a
+            # collapsing attack margin is visible live
+            numerics = last.get("numerics") or {}
+            gauges = [(short, numerics[key]) for short, key in
+                      (("unorm_p95", "update_norm_all_p95"),
+                       ("nonfinite", "nonfinite_count"),
+                       ("sep", "sep_margin"))
+                      if isinstance(numerics.get(key), (int, float))]
+            if gauges:
+                msg += ("  [" + " ".join(f"{k}={v:.4g}" for k, v in gauges)
+                        + "]")
             print(f"[watch] round {rnd} ok={last.get('ok')} "
                   f"{msg}".rstrip(), flush=True)
         if args.once:
@@ -344,7 +366,8 @@ commands:
   server   rendezvous server (waits for `client` registrations)
   client   register one client (reference client.py parity)
   metrics  summarize a run directory's events*.jsonl (p50/p95, rounds/s;
-           --merge: cross-host skew; --forensics: defense TPR/FPR)
+           --merge: cross-host skew; --forensics: defense TPR/FPR;
+           --numerics: in-graph device-side round metrics)
   watch    poll a live run's monitor endpoint (/last-round, /healthz)
 """
 

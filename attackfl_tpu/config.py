@@ -99,6 +99,15 @@ class TelemetryConfig:
     ``profile_rounds`` ("A:B") wraps those rounds in
     ``jax.profiler.start_trace/stop_trace`` writing device traces under
     ``<telemetry base>/profile``.
+
+    ``numerics`` enables the in-graph numerics engine (ops/metrics +
+    telemetry/numerics): per-round device-side metric rows (update-norm
+    distributions per cohort, attack separation, weight drift, non-finite
+    provenance, histograms) accumulated in a device ring buffer of
+    ``numerics_window`` rows and drained up to that many rounds late as
+    schema-v3 ``metric`` events — sync-free on the fused/pipelined paths,
+    one batched transfer per window on the synchronous path.  Metrics
+    never touch the params math (bit-identical global params on vs off).
     """
 
     enabled: bool = True
@@ -110,6 +119,8 @@ class TelemetryConfig:
     stall_factor: float = 10.0
     stall_grace_seconds: float = 900.0
     profile_rounds: str = ""
+    numerics: bool = False
+    numerics_window: int = 16
 
     def __post_init__(self):
         if self.sample_every < 1:
@@ -127,6 +138,10 @@ class TelemetryConfig:
                 f"telemetry.stall_grace_seconds must be > 0, got "
                 f"{self.stall_grace_seconds}")
         parse_profile_rounds(self.profile_rounds)  # validate format
+        if not 2 <= self.numerics_window <= 65536:
+            raise ValueError(
+                "telemetry.numerics_window must be in [2, 65536] (ring rows "
+                f"= max drain lateness in rounds), got {self.numerics_window}")
 
 
 @dataclass(frozen=True)
@@ -499,6 +514,8 @@ def config_from_dict(raw: dict) -> Config:
             stall_grace_seconds=float(
                 _get(tele, "stall-grace-seconds", 900.0)),
             profile_rounds=str(_get(tele, "profile-rounds", "")),
+            numerics=bool(_get(tele, "numerics", False)),
+            numerics_window=int(_get(tele, "numerics-window", 16)),
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),

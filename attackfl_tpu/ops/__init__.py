@@ -1,1 +1,1 @@
-from attackfl_tpu.ops import pytree  # noqa: F401
+from attackfl_tpu.ops import metrics, pytree  # noqa: F401

@@ -1,0 +1,311 @@
+"""Device-side numerics: the in-graph half of the numerics engine (ISSUE 4).
+
+The telemetry layer (``attackfl_tpu/telemetry``) records what the host can
+see — wall times, event lifecycles, defense verdicts.  This module computes
+what the NUMBERS are doing *inside* the jitted round: per-cohort
+update-norm distributions, genuine-vs-malicious separation margins, global
+weight-norm drift, loss drift, non-finite provenance (count, affected
+clients, first offending layer) and a fixed-bucket histogram — all as ONE
+``(M,)`` float32 row per round, written into a device-resident ring buffer
+carried in the simulation state.
+
+Nothing here ever materializes a device value on host
+(``scripts/check_host_sync.py`` lints this file): the host-side half — the
+k-rounds-late drainer that turns ring rows into schema-v3 ``metric``
+events — lives in :mod:`attackfl_tpu.telemetry.numerics`.
+
+Design (FedJAX-style accumulated metric pytrees — PAPERS.md; Federated AD
+argues round quantities should be first-class traced values): the metric
+registry is declarative and resolved at *program-build* time into a static
+slot :class:`MetricsLayout`, so the compute fn is shape-stable, rng-free
+and side-effect-free.  Closing it over ``round_step`` / the fused body /
+``_pipeline_step_fn`` therefore cannot perturb the params math — the
+bit-identical-params guarantee tested in ``tests/test_numerics.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attackfl_tpu.ops import pytree as pt
+
+# Fixed log-spaced histogram bucket edges for per-client update norms.
+# 15 internal edges -> 16 buckets: (-inf, 1e-3), [1e-3, ..), ..,
+# [1e3, inf).  Static by design: rows from different rounds (and runs) are
+# directly comparable, and bucketing stays one cheap searchsorted inside
+# the jitted round.
+HIST_EDGES = tuple(np.logspace(-3.0, 3.0, 15).tolist())
+NUM_HIST_BUCKETS = len(HIST_EDGES) + 1
+
+
+@dataclass(frozen=True)
+class MetricsLayout:
+    """Static slot layout of one numerics row (host-side metadata only).
+
+    A row is ``len(names)`` scalar gauge slots followed by
+    ``NUM_HIST_BUCKETS`` histogram-count slots.  ``leaf_names`` maps the
+    ``first_nonfinite_leaf`` slot's index back to a parameter-tree layer
+    name; ``cohorts`` records which client cohorts have update-norm
+    distribution slots.
+    """
+
+    names: tuple[str, ...]
+    leaf_names: tuple[str, ...]
+    cohorts: tuple[str, ...]
+    hist_edges: tuple[float, ...] = field(default=HIST_EDGES)
+
+    @property
+    def size(self) -> int:
+        return len(self.names) + NUM_HIST_BUCKETS
+
+    def index(self, name: str) -> int:
+        return self.names.index(name)
+
+
+def build_layout(params_template, has_attackers: bool) -> MetricsLayout:
+    """Resolve the metric registry for one configuration.
+
+    ``params_template`` is the (unstacked) client/target params tree —
+    concrete arrays or ShapeDtypeStructs; only its structure and leaf
+    paths are read.  ``has_attackers`` adds the malicious cohort and the
+    separation-margin slots (statically — an attack-free run pays no dead
+    slots).
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(params_template)[0]
+    leaf_names = tuple(pt.path_name(p) for p, _ in leaves)
+    cohorts = ("all", "genuine") + (("malicious",) if has_attackers else ())
+    names: list[str] = ["broadcast", "ok", "train_loss", "loss_delta"]
+    for cohort in cohorts:
+        names += [f"update_norm_{cohort}_p50", f"update_norm_{cohort}_p95",
+                  f"update_norm_{cohort}_max"]
+    if has_attackers:
+        names += ["sep_cosine", "sep_l2", "sep_margin"]
+    names += ["global_norm", "global_drift",
+              "nonfinite_count", "nonfinite_clients", "first_nonfinite_leaf"]
+    return MetricsLayout(tuple(names), leaf_names, cohorts)
+
+
+def masked_distribution(values: jnp.ndarray, mask: jnp.ndarray):
+    """p50 / p95 / max of ``values[mask]`` with a dynamic mask and static
+    shapes (traced-safe): masked entries sort to +inf, percentiles use
+    numpy's linear interpolation over the first ``n = sum(mask)`` sorted
+    entries.  An empty cohort yields NaN on every statistic.
+    """
+    c = values.shape[0]
+    n = jnp.sum(mask.astype(jnp.int32))
+    order = jnp.sort(jnp.where(mask, values, jnp.inf))
+
+    def pick(i):
+        return order[jnp.clip(i, 0, c - 1)]
+
+    def pct(q):
+        rank = (n - 1).astype(jnp.float32) * q
+        lo = jnp.floor(rank).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n - 1)
+        frac = rank - lo.astype(jnp.float32)
+        value = pick(lo) * (1.0 - frac) + pick(hi) * frac
+        return jnp.where(n > 0, value, jnp.nan)
+
+    maximum = jnp.where(n > 0, pick(n - 1), jnp.nan)
+    return pct(0.5), pct(0.95), maximum
+
+
+class Numerics:
+    """Traced numerics programs for one Simulator configuration.
+
+    ``genuine_mask`` / ``attacker_mask`` are host (C,) bool arrays — the
+    static attacker geometry.  ``window`` is the ring-buffer depth: the
+    host drainer may resolve rows up to ``window`` rounds late; rows older
+    than that are overwritten (counted, not silently lost — see
+    :class:`attackfl_tpu.telemetry.numerics.NumericsDrainer`).
+
+    Every method here is pure and traced-safe; none consumes rng or
+    touches the params math.
+    """
+
+    def __init__(self, layout: MetricsLayout, genuine_mask, attacker_mask,
+                 window: int):
+        self.layout = layout
+        self.genuine_mask = genuine_mask
+        self.attacker_mask = attacker_mask
+        self.has_attackers = bool(np.any(attacker_mask))
+        self.window = int(window)
+
+    # ------------------------------------------------------------------
+    # ring buffer
+    # ------------------------------------------------------------------
+
+    def init_state(self) -> dict:
+        """Fresh device-resident ring state, carried inside the round
+        state pytree (donation-safe: every round's write aliases the
+        buffer in place under jit)."""
+        return {
+            "buffer": jnp.full((self.window, self.layout.size), jnp.nan,
+                               jnp.float32),
+            "cursor": jnp.zeros((), jnp.int32),
+            "prev_loss": jnp.full((), jnp.nan, jnp.float32),
+        }
+
+    def write(self, num_state: dict, row: jnp.ndarray, loss) -> dict:
+        """Write one row at ``cursor % window`` and advance the cursor
+        (traced; the cursor's host mirror is the drainer's round count)."""
+        cursor = num_state["cursor"]
+        buffer = jax.lax.dynamic_update_slice(
+            num_state["buffer"], row[None, :],
+            (jnp.mod(cursor, self.window), jnp.int32(0)))
+        return {"buffer": buffer, "cursor": cursor + 1,
+                "prev_loss": jnp.asarray(loss, jnp.float32)}
+
+    # ------------------------------------------------------------------
+    # the metric row
+    # ------------------------------------------------------------------
+
+    def compute_row(self, base, old_ref, new_ref, stacked, sizes,
+                    prev_loss, loss, ok, broadcast) -> jnp.ndarray:
+        """One round's (M,) float32 metrics row (traced).
+
+        ``base`` is the broadcast reference the per-client updates are
+        measured against, as a PYTREE with the same leaf structure as
+        ``stacked``: the global params (leaves broadcast across the client
+        axis) on the plain path, or the per-client generated params
+        (stacked leaves) in hyper mode.  ``old_ref`` / ``new_ref`` are the
+        server-side trees (global or hypernetwork params) before/after
+        the round's ACCEPTED outcome — a failed round therefore shows
+        zero drift, exactly like the accept-select keeps the old params.
+
+        The big reductions stream LEAF BY LEAF — nothing ever
+        materializes the concatenated (C, P) update matrix (for the bench
+        workload that one concat plus its temporaries cost more than the
+        entire round).  Pass 1 is a bare Σd² per (leaf, client) — ONE
+        fused traversal of the stacked updates, no elementwise isfinite
+        pass: a non-finite element makes its leaf's partial sum
+        non-finite, so the (L, C) partial-sum matrix doubles as the
+        provenance signal at (client, layer) granularity.  Pass 2
+        (attacked runs only) folds the genuine/malicious cohort mean
+        geometry into three Gram scalars — the cosine and L2 separation
+        fall out of those without ever building a mean vector.
+        """
+        layout = self.layout
+        leaves = jax.tree.leaves(stacked)
+        base_leaves = jax.tree.leaves(base)
+        c = leaves[0].shape[0]
+        reporting = sizes > 0
+
+        # ---- pass 1: per-(leaf, client) Σd² — one traversal -------------
+        sq_mat = jnp.stack([
+            jnp.sum(jnp.square((x - b).astype(jnp.float32).reshape(c, -1)),
+                    axis=1)
+            for x, b in zip(leaves, base_leaves)])  # (L, C), tiny
+        # non-finite provenance falls out of the partial sums: NaN/Inf
+        # anywhere in a (leaf, client) block makes that entry non-finite.
+        # Counts are therefore at (client, layer) granularity — the
+        # resolution the report and first_nonfinite_leaf actually use —
+        # and a poisoned block contributes 0 to the client's norm, so one
+        # NaN client cannot poison the cohort statistics: its row is
+        # excluded from every cohort via `valid` and surfaces in the
+        # provenance slots instead
+        leaf_finite = jnp.isfinite(sq_mat)
+        norms = jnp.sqrt(jnp.sum(jnp.where(leaf_finite, sq_mat, 0.0),
+                                 axis=0))
+        bad_mat = ~leaf_finite
+        leaf_bad = jnp.sum(bad_mat, axis=1)        # (L,) clients hit/leaf
+        bad_per_client = jnp.sum(bad_mat, axis=0)  # (C,) leaves hit/client
+        finite = bad_per_client == 0
+        valid = reporting & finite
+
+        genuine = valid & jnp.asarray(self.genuine_mask)
+        slots: dict[str, jnp.ndarray] = {
+            "broadcast": jnp.asarray(broadcast),
+            "ok": jnp.asarray(ok),
+            "train_loss": jnp.asarray(loss),
+            "loss_delta": jnp.asarray(loss) - prev_loss,
+        }
+        cohort_masks = {"all": valid, "genuine": genuine}
+        if self.has_attackers:
+            cohort_masks["malicious"] = valid & jnp.asarray(self.attacker_mask)
+        for cohort in layout.cohorts:
+            p50, p95, mx = masked_distribution(norms, cohort_masks[cohort])
+            slots[f"update_norm_{cohort}_p50"] = p50
+            slots[f"update_norm_{cohort}_p95"] = p95
+            slots[f"update_norm_{cohort}_max"] = mx
+
+        if self.has_attackers:
+            malicious = cohort_masks["malicious"]
+            n_gen = jnp.sum(genuine.astype(jnp.float32))
+            n_mal = jnp.sum(malicious.astype(jnp.float32))
+            # ---- pass 2: cohort mean geometry as Gram scalars ----------
+            # s_x = Σ_c mask_c · d_c, so mean_x = s_x / n_x and every
+            # separation quantity is a function of ⟨s_gen,s_gen⟩,
+            # ⟨s_mal,s_mal⟩, ⟨s_gen,s_mal⟩ — one (2,C)@(C,leaf) matmul
+            # per leaf, never a materialized mean vector.  Invalid
+            # clients' rows are forced to zero (a 0-weight dot against a
+            # NaN row would still be NaN) — whole-row zeroing matches the
+            # cohort semantics: an invalid client contributes nothing.
+            weights = jnp.stack([genuine.astype(jnp.float32),
+                                 malicious.astype(jnp.float32)])
+            gram = jnp.zeros((2, 2), jnp.float32)
+            for x, b in zip(leaves, base_leaves):
+                d = (x - b).astype(jnp.float32).reshape(c, -1)
+                s = weights @ jnp.where(valid[:, None], d, 0.0)
+                gram += s @ s.T
+            gg, gm, mm = gram[0, 0], gram[0, 1], gram[1, 1]
+            both = (n_gen > 0) & (n_mal > 0)
+            cos = gm / jnp.maximum(jnp.sqrt(gg * mm), 1e-30)  # scale-free
+            l2_sq = (gg / jnp.maximum(n_gen, 1.0) ** 2
+                     - 2.0 * gm / jnp.maximum(n_gen * n_mal, 1.0)
+                     + mm / jnp.maximum(n_mal, 1.0) ** 2)
+            gen_norm = (jnp.sum(norms * genuine.astype(norms.dtype))
+                        / jnp.maximum(n_gen, 1.0))
+            mal_norm = (jnp.sum(norms * malicious.astype(norms.dtype))
+                        / jnp.maximum(n_mal, 1.0))
+            slots["sep_cosine"] = jnp.where(both, cos, jnp.nan)
+            slots["sep_l2"] = jnp.where(
+                both, jnp.sqrt(jnp.maximum(l2_sq, 0.0)), jnp.nan)
+            # how much louder the attacker cohort is than the genuine one
+            slots["sep_margin"] = jnp.where(both, mal_norm - gen_norm, jnp.nan)
+
+        # server-tree norms are C× smaller than the client reductions —
+        # per-leaf sums, again without a concat
+        new_sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                     for x in jax.tree.leaves(new_ref))
+        drift_sq = sum(
+            jnp.sum(jnp.square(n.astype(jnp.float32)
+                               - o.astype(jnp.float32)))
+            for n, o in zip(jax.tree.leaves(new_ref),
+                            jax.tree.leaves(old_ref)))
+        slots["global_norm"] = jnp.sqrt(new_sq)
+        slots["global_drift"] = jnp.sqrt(drift_sq)
+
+        # non-finite provenance: total (client, layer) hits, affected
+        # clients, and the FIRST leaf (layer) holding one —
+        # layout.leaf_names maps the index back to a layer name on host
+        total_bad = jnp.sum(leaf_bad)
+        slots["nonfinite_count"] = total_bad
+        slots["nonfinite_clients"] = jnp.sum(reporting & ~finite)
+        slots["first_nonfinite_leaf"] = jnp.where(
+            total_bad > 0, jnp.argmax(leaf_bad > 0), -1)
+
+        scalar = jnp.stack([jnp.asarray(slots[name]).astype(jnp.float32)
+                            for name in layout.names])
+        edges = jnp.asarray(layout.hist_edges, jnp.float32)
+        bucket = jnp.searchsorted(edges, norms.astype(jnp.float32),
+                                  side="right")
+        hist = jnp.sum(
+            jax.nn.one_hot(bucket, NUM_HIST_BUCKETS, dtype=jnp.float32)
+            * valid[:, None].astype(jnp.float32), axis=0)
+        return jnp.concatenate([scalar, hist])
+
+    def step(self, num_state, base, old_ref, new_ref, stacked, sizes,
+             loss, ok, broadcast):
+        """compute_row + ring write in one traced call.  Returns
+        ``(new_num_state, row)`` — the row is what the fused/pipelined
+        bodies surface through their metrics output (resolved by the
+        path's existing late sync), while the ring is what the sync path
+        drains in batches."""
+        row = self.compute_row(base, old_ref, new_ref, stacked, sizes,
+                               num_state["prev_loss"], loss, ok, broadcast)
+        return self.write(num_state, row, loss), row

@@ -41,6 +41,7 @@ from attackfl_tpu.telemetry.events import (  # noqa: F401
     validate_event,
 )
 from attackfl_tpu.telemetry.monitor import RunMonitor  # noqa: F401
+from attackfl_tpu.telemetry.numerics import NumericsDrainer  # noqa: F401
 from attackfl_tpu.telemetry.timing import RoundTimer  # noqa: F401
 from attackfl_tpu.telemetry.trace import NullTracer, Tracer  # noqa: F401
 from attackfl_tpu.telemetry.xla import memory_analysis_bytes  # noqa: F401
@@ -51,6 +52,7 @@ __all__ = [
     "Logger",
     "NullEventLog",
     "NullTracer",
+    "NumericsDrainer",
     "RoundTimer",
     "RunMonitor",
     "SCHEMA_VERSION",

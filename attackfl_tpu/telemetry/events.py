@@ -25,9 +25,21 @@ Schema v2 (ISSUE 2) extends v1 — every v1 file still validates:
   (:mod:`~attackfl_tpu.telemetry.forensics`);
 * ``profile`` — ``--profile-rounds`` device-trace window markers.
 
+Schema v3 (ISSUE 4) extends v2 — every v1/v2 file still validates:
+
+* ``metric`` events MAY carry per-round in-graph numerics from the
+  device-side engine (:mod:`attackfl_tpu.ops.metrics` /
+  :mod:`attackfl_tpu.telemetry.numerics`): ``round``/``broadcast`` ints, a
+  ``numerics`` gauge mapping (slot name -> number, or null for a
+  non-finite value) and a ``hist`` fixed-bucket count list.  All four are
+  optional and type-checked only when present (v1/v2 ``metric`` records
+  carry none of them).
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
-inside traced/jitted code.
+inside traced/jitted code.  The numerics rows respect the same contract:
+they are computed ON DEVICE inside the jitted round and reach this module
+only after the drainer's late host materialization.
 """
 
 from __future__ import annotations
@@ -39,7 +51,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -68,6 +80,12 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
                     "kept": list, "removed": list},
     # jax.profiler --profile-rounds window markers
     "profile": {"action": str},
+}
+
+# --- schema v3: optional numerics payload on `metric` events ---
+# (type-checked when present; a v1/v2 metric record carries none of these)
+_OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
+    "round": int, "broadcast": int, "numerics": dict, "hist": list,
 }
 
 _COMMON_FIELDS: dict[str, Any] = {"schema": int, "kind": str, "ts": _NUM}
@@ -144,6 +162,13 @@ def validate_event(record: Any) -> list[str]:
                     errors.append(
                         f"[{kind}] '{name}' must be {typ.__name__}, got "
                         f"{type(value).__name__}")
+        if kind == "metric":
+            for name, typ in _OPTIONAL_METRIC_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[metric] '{name}' must be {typ.__name__}, got "
+                        f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:
         errors.append(f"schema version {schema} is newer than "

@@ -65,7 +65,12 @@ def forensics_summary(events: list[dict[str, Any]]) -> dict[str, Any] | None:
     per_round: list[dict[str, Any]] = []
     totals = {"tp": 0, "fp": 0, "fn": 0, "tn": 0}
     mode = None
+    source = None
     attack_rounds = 0
+    # hyper-detection (ISSUE 4 satellite): its attribution events carry
+    # source="hyper_detection", and a removal there also ROLLS THE ROUND
+    # BACK — surface the rollback count next to the detection quality
+    rollbacks = sum(1 for e in events if e.get("kind") == "rollback")
     for event in events:
         if event.get("kind") != "attribution":
             continue
@@ -75,6 +80,7 @@ def forensics_summary(events: list[dict[str, Any]]) -> dict[str, Any] | None:
             continue
         seen.add(key)
         mode = event.get("mode", mode)
+        source = event.get("source", source)
         counts = confusion_counts(event.get("attackers") or [],
                                   event.get("kept") or [],
                                   event.get("removed") or [])
@@ -93,8 +99,10 @@ def forensics_summary(events: list[dict[str, Any]]) -> dict[str, Any] | None:
         return None
     return {
         "mode": mode,
+        "source": source,
         "rounds": len(per_round),
         "attack_rounds": attack_rounds,
+        "rollbacks": rollbacks,
         **totals,
         **rates(**totals),
         "per_round": per_round,
@@ -108,6 +116,7 @@ def format_forensics(summary: dict[str, Any],
 
     lines = [
         f"defense forensics — mode={summary['mode']}"
+        + (f" [{summary['source']}]" if summary.get("source") else "")
         + (f" run {run_id}" if run_id else ""),
         f"rounds with attribution: {summary['rounds']} "
         f"({summary['attack_rounds']} under active attack)",
@@ -116,6 +125,9 @@ def format_forensics(summary: dict[str, Any],
         f"TPR={fmt(summary['tpr'])} FPR={fmt(summary['fpr'])} "
         f"precision={fmt(summary['precision'])}",
     ]
+    if summary.get("rollbacks"):
+        lines.append(f"rollbacks: {summary['rollbacks']} round(s) rolled "
+                     "back by detection removals")
     flagged = [r for r in summary["per_round"] if r["attackers"]]
     if flagged:
         lines.append(f"{'round':<8}{'attackers':>10}{'removed':>9}"

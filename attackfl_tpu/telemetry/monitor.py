@@ -69,6 +69,10 @@ class RunMonitor:
         self._lock = threading.Lock()
         self._durations: deque[float] = deque(maxlen=history)
         self._last_round: dict[str, Any] | None = None
+        # latest drained numerics gauges (ISSUE 4): fed by the numerics
+        # drainer's on_gauges callback, up to numerics_window rounds late
+        # on the synchronous path, one round late on the pipelined one
+        self._last_numerics: dict[str, float] = {}
         self._last_beat: float | None = None  # monotonic; set by start()
         self._rounds_completed = 0
         self._active = False  # watchdog only arms between run start/end
@@ -158,6 +162,14 @@ class RunMonitor:
             self._stalled = False
             self._stall_info = {}
 
+    def update_numerics(self, gauges: dict[str, Any]) -> None:
+        """Record the latest drained numerics row (non-finite gauges
+        arrive as None and are skipped — Prometheus gauges are numbers)."""
+        with self._lock:
+            self._last_numerics = {
+                k: v for k, v in gauges.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
     def stall_threshold_seconds(self) -> float:
         """Current stall threshold: stall_factor × rolling-median round
         time (floored), or the grace window before any round completed."""
@@ -217,7 +229,10 @@ class RunMonitor:
 
     def last_round(self) -> dict[str, Any]:
         with self._lock:
-            return dict(self._last_round or {})
+            out = dict(self._last_round or {})
+            if self._last_numerics:
+                out["numerics"] = dict(self._last_numerics)
+            return out
 
     def metrics_text(self) -> str:
         """The Counters registry + round/stall gauges in Prometheus text
@@ -225,6 +240,7 @@ class RunMonitor:
         with self._lock:
             durations = list(self._durations)
             last = dict(self._last_round or {})
+            numerics = dict(self._last_numerics)
             rounds = self._rounds_completed
             stalled = int(self._stalled)
         lines = [
@@ -250,6 +266,12 @@ class RunMonitor:
                     lines.append(
                         f'attackfl_last_round_phase_seconds'
                         f'{{phase="{_sanitize(str(phase))}"}} {dur:.6f}')
+        if numerics:
+            lines.append("# TYPE attackfl_numerics gauge")
+            for name, value in numerics.items():
+                lines.append(
+                    f'attackfl_numerics{{name="{_sanitize(str(name))}"}} '
+                    f'{value:.6g}')
         counters = self._tel.counters.snapshot()
         if counters:
             lines.append("# TYPE attackfl_counter counter")

@@ -254,6 +254,8 @@ def _merge_main(args) -> int:
         return 2
     if args.forensics:
         return _forensics_main(args, merged)
+    if args.numerics:
+        return _numerics_main(args, merged)
     skew = merge_mod.skew_summary(merged)
     if args.json:
         print(json.dumps({
@@ -262,6 +264,37 @@ def _merge_main(args) -> int:
         }, indent=1))
     else:
         print(merge_mod.format_merge_report(merged, per_process, skew))
+    return 0
+
+
+def _numerics_main(args, events: list[dict[str, Any]]) -> int:
+    from attackfl_tpu.telemetry.numerics import (
+        format_numerics, numerics_summary,
+    )
+
+    runs = _select_runs(events, args.run_id, args.all)
+    if not runs:
+        print(f"no events recorded in {args.path!r}", file=sys.stderr)
+        return 2
+    reports = []
+    for run in runs:
+        summary = numerics_summary(run)
+        if summary is not None:
+            run_id = next((e.get("run_id") for e in run
+                           if e.get("run_id")), None)
+            reports.append((run_id, summary))
+    if not reports:
+        print("no numerics metric events found (enable telemetry.numerics "
+              "/ --numerics on the run, or a pre-v3 artifact)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([dict(s, run_id=rid) for rid, s in reports]
+                         if args.all or len(reports) > 1
+                         else dict(reports[0][1], run_id=reports[0][0]),
+                         indent=1))
+    else:
+        print("\n\n".join(format_numerics(s, rid) for rid, s in reports))
     return 0
 
 
@@ -303,7 +336,9 @@ def main(argv: list[str] | None = None) -> int:
                     "--merge interleaves a run directory's per-process "
                     "events.<i>.jsonl files by ts and reports cross-host "
                     "round skew; --forensics reports the defense's "
-                    "TPR/FPR/precision from attribution events.")
+                    "TPR/FPR/precision from attribution events; "
+                    "--numerics reports the in-graph device-side round "
+                    "metrics.")
     parser.add_argument("path", nargs="?", default=".",
                         help="events.jsonl or a directory containing it")
     parser.add_argument("--run-id", type=str, default=None,
@@ -318,6 +353,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--forensics", action="store_true",
                         help="defense detection quality (TPR/FPR) from "
                              "attribution events")
+    parser.add_argument("--numerics", action="store_true",
+                        help="per-round device-side numerics report "
+                             "(update-norm distributions, attack "
+                             "separation, drift, non-finite provenance) "
+                             "from schema-v3 metric events")
     args = parser.parse_args(argv)
 
     if args.merge:
@@ -330,6 +370,8 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     if args.forensics:
         return _forensics_main(args, events)
+    if args.numerics:
+        return _numerics_main(args, events)
     runs = split_runs(events)
     if not runs:
         print(f"no events recorded in {args.path!r}", file=sys.stderr)

@@ -54,10 +54,12 @@ from attackfl_tpu.telemetry.xla import (
     memory_analysis_bytes,
 )
 from attackfl_tpu.training.hyper import build_hyper_round, build_hyper_update, make_hyper_optimizer
+from attackfl_tpu.ops import metrics as num_metrics
+from attackfl_tpu.telemetry.numerics import NumericsDrainer
 from attackfl_tpu.training.round import (
     active_attack_modes, active_attacker_indices, build_aggregator,
-    build_attack_groups, build_attribution_fn, build_round_step,
-    describe_attack_groups,
+    build_attack_groups, build_attribution_fn, build_cohort_masks,
+    build_round_step, describe_attack_groups,
 )
 from attackfl_tpu.utils import checkpoint as ckpt
 
@@ -116,9 +118,8 @@ class Simulator:
         self.test_np = test_np
 
         self.attack_groups, self.genuine_idx = build_attack_groups(cfg)
-        self.attacker_mask = np.zeros(cfg.total_clients, dtype=bool)
-        for grp in self.attack_groups:
-            self.attacker_mask[list(grp.indices)] = True
+        self.genuine_mask, self.attacker_mask = build_cohort_masks(
+            cfg.total_clients, self.attack_groups)
 
         self.client_pools = None
         if cfg.partition == "dirichlet":
@@ -187,6 +188,10 @@ class Simulator:
         else:
             self.telemetry = Telemetry.from_config(cfg)
         self._header_emitted = False
+        # in-graph numerics (ISSUE 4): decided before the round programs
+        # are jitted because it changes their donation policy (below)
+        self._numerics_on = bool(self.telemetry.enabled
+                                 and cfg.telemetry.numerics)
         self._nan_counter: Callable | None = None
         # AOT-compiled fused chunk programs, keyed by scan length (False =
         # AOT failed for this length; fall back to the lazy jit path)
@@ -245,8 +250,16 @@ class Simulator:
             )
             # donate the stacked client-params tree: the hnet step is its
             # last consumer each round, so its HBM copy is recycled in
-            # place instead of living alongside the update's temporaries
-            self.hyper_update = jax.jit(hyper_update, donate_argnums=(2,))
+            # place instead of living alongside the update's temporaries.
+            # With in-graph numerics on, the numerics step reads `stacked`
+            # AFTER this dispatch on the synchronous path, so donation is
+            # off there (values are unchanged either way — donation is an
+            # aliasing hint, never arithmetic); the pipelined/fused paths
+            # keep full donation because their numerics live inside the
+            # same program.
+            self.hyper_update = jax.jit(
+                hyper_update,
+                donate_argnums=() if self._numerics_on else (2,))
             self._hyper_update_raw = hyper_update
             self.detector = None
             if cfg.hyper_detection.enable:
@@ -269,8 +282,15 @@ class Simulator:
             # host defenses and the attribution program read it first), so
             # XLA reuses its HBM for the reduction instead of holding a
             # second copy.  Do NOT pass the same stacked tree to anything
-            # after self.aggregate.
-            self.aggregate = jax.jit(aggregate, donate_argnums=(1,))
+            # after self.aggregate.  Exception: with in-graph numerics on,
+            # the numerics step is dispatched after aggregation and reads
+            # `stacked`, so donation is off on this synchronous-path
+            # program (an aliasing hint only — the aggregated values are
+            # bit-identical either way; fused/pipelined paths keep
+            # donation since their numerics are inside the same program).
+            self.aggregate = jax.jit(
+                aggregate,
+                donate_argnums=() if self._numerics_on else (1,))
             self._aggregate_raw = aggregate
 
         # ---- defense forensics ------------------------------------------
@@ -286,6 +306,59 @@ class Simulator:
             attribution = build_attribution_fn(self.model, cfg, test_np)
             if attribution is not None:
                 self._attribution = jax.jit(attribution)
+
+        # ---- in-graph numerics engine (ISSUE 4) --------------------------
+        # Device-side metric rows (ops/metrics) accumulated in a ring
+        # buffer carried in the round state; the drainer
+        # (telemetry/numerics) resolves them up to `numerics_window` rounds
+        # late — piggybacking on the fused/pipelined paths' existing late
+        # materialization, one batched transfer per window on the
+        # synchronous path.  The step consumes no rng and never feeds the
+        # params math: global params are bit-identical on vs off.
+        self._numerics = None
+        self._numerics_drainer = None
+        self._numerics_step = None
+        self._numerics_step_raw = None
+        if self._numerics_on:
+            if self.is_hyper:
+                template = self.target_template
+            else:
+                # leaf structure only — eval_shape never runs the init
+                template = jax.eval_shape(
+                    lambda key: self.model.init(
+                        key, *sample_inputs(cfg.data_name))["params"],
+                    jax.random.key(cfg.random_seed, impl=cfg.prng_impl))
+            layout = num_metrics.build_layout(
+                template, bool(self.attack_groups))
+            self._numerics = num_metrics.Numerics(
+                layout, self.genuine_mask, self.attacker_mask,
+                window=cfg.telemetry.numerics_window)
+            self._numerics_drainer = NumericsDrainer(
+                layout, self.telemetry, cfg.telemetry.numerics_window,
+                on_gauges=(self.monitor.update_numerics
+                           if self.monitor is not None else None))
+            numerics = self._numerics
+            if self.is_hyper:
+                gen_raw = self._generate_all_raw
+
+                def numerics_step(num_state, old_ref, new_ref, stacked,
+                                  sizes, loss, ok, broadcast):
+                    # client updates are measured against the params the
+                    # hnet GENERATED for them this broadcast; inside the
+                    # fused/pipelined program XLA CSEs this with
+                    # round_step's own generate_all call
+                    base = gen_raw(old_ref)[0]
+                    return numerics.step(num_state, base, old_ref, new_ref,
+                                         stacked, sizes, loss, ok, broadcast)
+            else:
+                def numerics_step(num_state, old_ref, new_ref, stacked,
+                                  sizes, loss, ok, broadcast):
+                    # old_ref's leaves broadcast across the client axis
+                    return numerics.step(num_state, old_ref, old_ref,
+                                         new_ref, stacked, sizes, loss, ok,
+                                         broadcast)
+            self._numerics_step_raw = numerics_step
+            self._numerics_step = jax.jit(numerics_step)
 
         self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
         self._fused_cache: dict[int, Callable] = {}
@@ -318,6 +391,18 @@ class Simulator:
             # multi-process mesh: replicate them globally (every process
             # computed identical values from the shared seed)
             state = replicate_to_mesh(state, self.mesh)
+        return self._ensure_numerics_state(state)
+
+    def _ensure_numerics_state(self, state: dict[str, Any]) -> dict[str, Any]:
+        """Attach the numerics ring to a state that lacks one (fresh init,
+        checkpoint resume, or a state built before numerics was enabled).
+        The ring is observability state: it is NOT part of checkpoints
+        (_save_checkpoint strips it; _init_host_state — the resume
+        template — never carries it), so resume stays structure-compatible
+        across numerics on/off and a resumed run simply starts a fresh
+        ring."""
+        if self._numerics is not None and "numerics" not in state:
+            state = dict(state, numerics=self._numerics.init_state())
         return state
 
     def _init_host_state(self, seed: int | None = None) -> dict[str, Any]:
@@ -382,12 +467,19 @@ class Simulator:
             print_with_color(
                 f"Load state from checkpoint (process-0 broadcast): {path}",
                 "yellow")
-            return replicate_to_mesh(host, self.mesh)
+            return self._ensure_numerics_state(
+                replicate_to_mesh(host, self.mesh))
         state = self.init_state()
         if self.cfg.load_parameters:
             path = ckpt.checkpoint_path(self.cfg)
+            # checkpoints never hold the numerics ring — load against a
+            # ring-less template, then re-attach this run's fresh ring
+            template = {k: v for k, v in state.items() if k != "numerics"}
             try:
-                state = ckpt.load_state(path, state)
+                loaded = ckpt.load_state(path, template)
+                if "numerics" in state:
+                    loaded["numerics"] = state["numerics"]
+                state = loaded
                 print_with_color(f"Load state from checkpoint: {path}", "yellow")
             except FileNotFoundError:
                 pass
@@ -411,6 +503,14 @@ class Simulator:
             info = getattr(fn, "telemetry_info", None)
             if info:
                 programs[name] = info
+        if self._numerics is not None:
+            programs["numerics"] = {
+                "program": "numerics_step",
+                "slots": self._numerics.layout.size,
+                "window": self._numerics.window,
+                "metrics": list(self._numerics.layout.names),
+                "leaf_names": list(self._numerics.layout.leaf_names),
+            }
         tel.events.emit(
             "run_header",
             backend=jax.default_backend(),
@@ -487,13 +587,18 @@ class Simulator:
             self._nan_counter = jax.jit(count)
         return int(self._nan_counter(stacked))
 
-    def _finish_run(self, history: list[dict[str, Any]], t_start: float) -> None:
+    def _finish_run(self, history: list[dict[str, Any]], t_start: float,
+                    state: dict[str, Any] | None = None) -> None:
         """Terminal work of a run()/run_fast() call: resolve in-flight
-        async validations, drain the background checkpoint writer (the
-        final state is durably on disk before the call returns), then the
-        counters snapshot, compile-cache stats, a run_end record, and the
-        Chrome trace file."""
+        async validations, drain any un-emitted numerics ring rows (the
+        synchronous path batches them — ``state`` carries the ring), drain
+        the background checkpoint writer (the final state is durably on
+        disk before the call returns), then the counters snapshot,
+        compile-cache stats, a run_end record, and the Chrome trace
+        file."""
         self._resolve_inflight_validations()
+        if self._numerics_drainer is not None and state is not None:
+            self._numerics_drainer.drain(state.get("numerics"))
         if self._ckpt_writer is not None:
             self._ckpt_writer.drain()
         tel = self.telemetry
@@ -632,7 +737,10 @@ class Simulator:
         path = ckpt.checkpoint_path(self.cfg)
         writer = self._ckpt_writer
         with self.telemetry.tracer.span("checkpoint", background=writer is not None):
-            target = state
+            # the numerics ring is observability state, excluded from
+            # checkpoints (resume compatibility across numerics on/off;
+            # a resumed run starts a fresh ring)
+            target = {k: v for k, v in state.items() if k != "numerics"}
             write_here = True
             if self.multiprocess:
                 target = gather_to_host(state)
@@ -683,7 +791,9 @@ class Simulator:
                     params = self._reload_cache[1]
                     self.telemetry.counters.inc("reload_cache_hits")
                 else:
-                    params = ckpt.load_state(path, state)["global_params"]
+                    params = ckpt.load_state(
+                        path, {k: v for k, v in state.items()
+                               if k != "numerics"})["global_params"]
                     self._reload_cache = (key, params)
                     self.telemetry.counters.inc("reload_cache_misses")
                 state = dict(state, global_params=params)
@@ -821,6 +931,20 @@ class Simulator:
         if ok:
             new_state["global_params"] = new_global
             new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
+        if self._numerics is not None:
+            with timer.phase("numerics"):
+                # dispatch-only: the row lands in the device ring (stacked
+                # is still alive — aggregation does not donate it with
+                # numerics on); `accepted` mirrors the fused body's accept
+                # select, so a failed round records zero drift
+                accepted = new_global if ok else state["global_params"]
+                new_state["numerics"], _ = self._numerics_step(
+                    state["numerics"], state["global_params"], accepted,
+                    stacked, sizes, loss, jnp.asarray(ok),
+                    jnp.asarray(broadcast_number))
+            self._numerics_drainer.note_round(
+                metrics["round"], broadcast_number)
+            self._numerics_drainer.maybe_drain(new_state["numerics"])
         return new_state, metrics
 
     def _run_hyper_round(self, state, rng, k_round, broadcast_number, metrics):
@@ -857,7 +981,8 @@ class Simulator:
             with timer.phase("hyper_update"):
                 hnet_params, opt_state = self.hyper_update(
                     # dropped clients (size 0) skip their hnet step;
-                    # self.hyper_update DONATES stacked (last consumer)
+                    # self.hyper_update DONATES stacked (last consumer) —
+                    # unless numerics is on, which reads it afterwards
                     hnet_params, opt_state, stacked, active_mask * (sizes > 0)
                 )
                 jax.block_until_ready(hnet_params)
@@ -886,6 +1011,31 @@ class Simulator:
                         new_active[cid] = 0.0
                     hnet_params, opt_state = prev_hnet, prev_opt
                     gen_params = None  # rollback invalidates the generation
+                if tel.enabled and self.attack_groups:
+                    # hyper-detection forensics (folds the detector into
+                    # `metrics --forensics`): ground-truth attackers among
+                    # this round's still-active clients vs the detector's
+                    # removal verdict, scored by embedding L2 norm.  A
+                    # round with no removals is still a (negative) verdict
+                    # — it gives TPR/FPR their denominators.
+                    active = set(active_attacker_indices(
+                        self.attack_groups, broadcast_number,
+                        bool(state["have_genuine"])))
+                    removed_set = set(int(c) for c in removals)
+                    kept = [c for c in selected if c not in removed_set]
+                    metrics["defense_removed"] = len(removed_set)
+                    tel.events.emit(
+                        "attribution",
+                        round=metrics["round"], broadcast=broadcast_number,
+                        mode=cfg.mode, source="hyper_detection",
+                        attackers=[c for c in selected if c in active],
+                        kept=kept, removed=sorted(removed_set),
+                        non_reporting=[c for c in range(cfg.total_clients)
+                                       if c not in set(selected)],
+                        scores={str(c): round(float(n), 6) for c, n in
+                                zip(selected,
+                                    np.linalg.norm(emb_np, axis=1))},
+                    )
 
             if self._validation_due(broadcast_number):
                 if gen_params is None:
@@ -918,6 +1068,18 @@ class Simulator:
             new_state["hnet_params"] = hnet_params
             new_state["hyper_opt_state"] = opt_state
             new_state["completed_rounds"] = np.asarray(int(state["completed_rounds"]) + 1)
+        if self._numerics is not None:
+            with timer.phase("numerics"):
+                # `hnet_params` already reflects rollback (drift 0 on a
+                # rolled-back round); a failed round keeps the old params
+                accepted = hnet_params if ok else state["hnet_params"]
+                new_state["numerics"], _ = self._numerics_step(
+                    state["numerics"], state["hnet_params"], accepted,
+                    stacked, sizes, loss, jnp.asarray(ok),
+                    jnp.asarray(broadcast_number))
+            self._numerics_drainer.note_round(
+                metrics["round"], broadcast_number)
+            self._numerics_drainer.maybe_drain(new_state["numerics"])
         return new_state, metrics
 
     # ------------------------------------------------------------------
@@ -966,6 +1128,12 @@ class Simulator:
             eval_fn = (self.validation.eval_hyper_fn if self.is_hyper
                        else self.validation.eval_fn)
         val_every = max(int(cfg.validation_every), 1)
+        # in-graph numerics: the row is computed INSIDE this same program
+        # (reductions fuse into the round; no extra dispatch), written to
+        # the ring carried in the state AND surfaced through the metrics
+        # output, which the scan stacks / the pipelined resolve
+        # materializes one round late
+        numerics_step = self._numerics_step_raw
 
         def gated_eval(b, make_ev):
             """Run ``make_ev`` when this broadcast is due for validation;
@@ -1031,6 +1199,12 @@ class Simulator:
                     "completed_rounds": state["completed_rounds"] + ok.astype(jnp.int32),
                     "broadcasts": b,
                 }
+                if numerics_step is not None:
+                    new_state["numerics"], metrics["numerics_row"] = \
+                        numerics_step(
+                            state["numerics"], state["hnet_params"],
+                            new_state["hnet_params"], stacked, sizes, loss,
+                            ok, b)
                 metrics["ok"] = ok
                 return new_state, metrics
 
@@ -1073,6 +1247,14 @@ class Simulator:
                     "completed_rounds": state["completed_rounds"] + ok.astype(jnp.int32),
                     "broadcasts": b,
                 }
+                if numerics_step is not None:
+                    # measured against the ACCEPTED params (a failed
+                    # round's drift is 0), matching the sync path
+                    new_state["numerics"], metrics["numerics_row"] = \
+                        numerics_step(
+                            state["numerics"], state["global_params"],
+                            new_state["global_params"], stacked, sizes,
+                            loss, ok, b)
                 metrics["ok"] = ok
                 return new_state, metrics
 
@@ -1134,6 +1316,20 @@ class Simulator:
         out["have_genuine"] = jnp.asarray(bool(state["have_genuine"]))
         if "active_mask" in out:
             out["active_mask"] = jnp.asarray(state["active_mask"], jnp.float32)
+        if self._numerics is not None:
+            if "numerics" not in out:
+                out["numerics"] = self._numerics.init_state()
+            else:
+                num = dict(out["numerics"])
+                num["buffer"] = jnp.asarray(num["buffer"], jnp.float32)
+                num["cursor"] = jnp.asarray(num["cursor"], jnp.int32)
+                num["prev_loss"] = jnp.asarray(num["prev_loss"], jnp.float32)
+                out["numerics"] = num
+        else:
+            # a state built under a numerics-enabled Simulator fed to a
+            # numerics-off one: the fused body would drop the key from the
+            # scan carry (structure mismatch) — drop it up front instead
+            out.pop("numerics", None)
         return out
 
     def run_scan(
@@ -1194,7 +1390,8 @@ class Simulator:
         cfg = self.cfg
         tel = self.telemetry
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
-        state = state if state is not None else self.load_or_init_state()
+        state = self._ensure_numerics_state(
+            state if state is not None else self.load_or_init_state())
         self._emit_run_header()
         history: list[dict[str, Any]] = []
         consecutive_failures = 0  # run()'s retry counter semantics
@@ -1239,6 +1436,9 @@ class Simulator:
             tel.events.emit("chunk", chunk_len=n, seconds=round(elapsed, 6),
                             includes_compile=includes_compile)
             host = {k: np.asarray(v) for k, v in metrics.items()}
+            # the scan stacked one numerics row per round — already host
+            # numpy via the per-chunk materialization above (no new sync)
+            numerics_rows = host.pop("numerics_row", None)
             broadcasts_after = int(state["broadcasts"])
             for i in range(n):
                 entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
@@ -1251,6 +1451,9 @@ class Simulator:
                 entry["chunk_len"] = n
                 entry["round"] = len(history) + 1  # attempt index
                 entry["broadcast"] = broadcasts_after - n + i + 1
+                if numerics_rows is not None:
+                    self._numerics_drainer.push_host_row(
+                        entry["round"], entry["broadcast"], numerics_rows[i])
                 history.append(entry)
                 tel.events.round_event(entry)
                 if self.monitor is not None:
@@ -1264,7 +1467,7 @@ class Simulator:
                     tel.counters.inc("rounds_failed")
             self._maybe_stop_profile(int(state["completed_rounds"]))
             if consecutive_failures > MAX_ROUND_RETRIES:
-                self._finish_run(history, t_start)
+                self._finish_run(history, t_start, state)
                 raise RuntimeError(
                     f"round failed {consecutive_failures} times in a row; "
                     "aborting (the reference would retry forever, "
@@ -1285,7 +1488,7 @@ class Simulator:
                 print_with_color(
                     f"[fast] {done}/{num_rounds} rounds, chunk of {n} in "
                     f"{elapsed:.2f}s ({elapsed / n:.3f}s/round) {msg}", "green")
-        self._finish_run(history, t_start)
+        self._finish_run(history, t_start, state)
         return state, history
 
     # ------------------------------------------------------------------
@@ -1341,13 +1544,19 @@ class Simulator:
                                 round_no: int) -> dict[str, Any]:
         """Materialize one pipelined round's metrics — the ONLY host sync
         of the pipelined path, and it happens while the NEXT round's
-        program is already in flight on the device."""
+        program is already in flight on the device.  The numerics row
+        (in-graph metrics) rides this same sync: draining it adds zero
+        transfers to the pipelined path."""
         host = {k: np.asarray(v) for k, v in pending["metrics"].items()}
+        numerics_row = host.pop("numerics_row", None)
         entry: dict[str, Any] = {
             k: (bool(v) if k == "ok" else float(v)) for k, v in host.items()}
         entry["round"] = round_no
         entry["broadcast"] = pending["broadcast"]
         entry["pipelined"] = True
+        if numerics_row is not None:
+            self._numerics_drainer.push_host_row(
+                round_no, pending["broadcast"], numerics_row)
         if pending["val"] is not None:
             # async validation for this round was dispatched alongside the
             # round program; by resolve time it has had a full round of
@@ -1467,7 +1676,7 @@ class Simulator:
                         f"Round {round_no} failed "
                         f"(retry {consecutive_failures})")
                     if consecutive_failures > MAX_ROUND_RETRIES:
-                        self._finish_run(history, t_start)
+                        self._finish_run(history, t_start, state)
                         raise RuntimeError(
                             f"Round {round_no} failed "
                             f"{consecutive_failures} times; aborting (the "
@@ -1475,7 +1684,7 @@ class Simulator:
                             "server.py:546-556)")
                 self._maybe_stop_profile(completed)
             pending = new_pending
-        self._finish_run(history, t_start)
+        self._finish_run(history, t_start, state)
         return state, history
 
     # ------------------------------------------------------------------
@@ -1502,7 +1711,8 @@ class Simulator:
         loop with a warning."""
         cfg = self.cfg
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
-        state = state if state is not None else self.load_or_init_state()
+        state = self._ensure_numerics_state(
+            state if state is not None else self.load_or_init_state())
         self._emit_run_header()
         use_pipeline = cfg.pipeline if pipeline is None else pipeline
         if use_pipeline:
@@ -1550,10 +1760,10 @@ class Simulator:
                 print_with_color("Training failed!", "yellow")
                 self.logger.log_warning(f"Round {round_no} failed (retry {retries})")
                 if retries > MAX_ROUND_RETRIES:
-                    self._finish_run(history, t_start)
+                    self._finish_run(history, t_start, state)
                     raise RuntimeError(
                         f"Round {round_no} failed {retries} times; aborting "
                         "(the reference would retry forever, server.py:546-556)"
                     )
-        self._finish_run(history, t_start)
+        self._finish_run(history, t_start, state)
         return state, history

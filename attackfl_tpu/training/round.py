@@ -97,6 +97,21 @@ def build_attack_groups(cfg: Config) -> tuple[list[AttackGroup], list[int]]:
     return group_list, genuine
 
 
+def build_cohort_masks(
+    total_clients: int, groups: Sequence[AttackGroup]
+) -> tuple[np.ndarray, np.ndarray]:
+    """(genuine_mask, attacker_mask) host bool arrays over client indices —
+    the static cohort geometry shared by the engine's defense bookkeeping
+    and the numerics layout (ops/metrics.build_layout).  A configured
+    attacker is "malicious" for cohort statistics even on rounds before
+    its attack fires (cohort membership is static; per-round activation is
+    what ``active_attacker_indices`` reports)."""
+    attacker = np.zeros(total_clients, dtype=bool)
+    for grp in groups:
+        attacker[list(grp.indices)] = True
+    return ~attacker, attacker
+
+
 def describe_attack_groups(groups: Sequence[AttackGroup]) -> list[dict[str, Any]]:
     """JSON-ready attacker geometry for the telemetry run header."""
     return [

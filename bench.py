@@ -316,6 +316,90 @@ def measure_pipeline_compare(rounds: int, log_path: str,
     return out
 
 
+def measure_numerics_overhead(rounds: int, log_path: str,
+                              reps: int = 4) -> dict:
+    """Steady-state rounds/s of the pipelined executor with the full
+    in-graph numerics metric set OFF vs ON (``telemetry.numerics``), on
+    the pipeline-compare workload.  The acceptance bar (ISSUE 4) is a
+    <= 3% steady regression: on the pipelined path the metric reductions
+    live inside the same jitted program and their rows ride the existing
+    one-round-late resolve, so the added cost is pure device compute.
+
+    Protocol: the off/on measurement order ALTERNATES per rep (even reps
+    so both orders appear equally) and the overhead is computed from the
+    PAIRED MEANS, not best-of — on a drifting CPU box best-of compares
+    two different time slots and routinely overstates a small delta by
+    more than the delta itself (alternation cancels linear drift in the
+    mean).  Both the best and mean rates are reported.  Unlike --pipeline-compare — which deliberately thins local
+    training to one step per client to amplify the host overheads it
+    measures — this workload trains 3 local epochs per client (the
+    reference config trains 5): the numerics cost is pure device compute,
+    so its honest denominator is a round with representative device
+    compute, not a host-overhead microbenchmark.
+    Also asserts the bit-identical-params guarantee: a short run from the
+    same seed must produce byte-equal global params on vs off.
+    """
+    import os
+
+    import jax
+    import numpy as np
+
+    from attackfl_tpu.config import Config  # noqa: F401 (doc pointer)
+    from attackfl_tpu.training.engine import Simulator
+
+    os.makedirs(log_path, exist_ok=True)
+    base = pipeline_compare_config(log_path).replace(pipeline=True, epochs=3)
+    on_cfg = base.replace(telemetry=dataclasses.replace(
+        base.telemetry, numerics=True))
+    out: dict = {"config": "numerics-overhead: 192 clients ICU Transformer, "
+                           "3 local epochs, pipelined, validation on, no "
+                           "checkpoints",
+                 "timed_rounds_per_rep": rounds, "reps": reps}
+
+    def make(cfg):
+        sim = Simulator(cfg)
+        sim.run(num_rounds=1, state=sim.init_state(),
+                save_checkpoints=False, verbose=False)
+        return sim
+
+    def timed_rep(sim) -> float:
+        state = sim.init_state()
+        t0 = time.perf_counter()
+        _, hist = sim.run(num_rounds=rounds, state=state,
+                          save_checkpoints=False, verbose=False)
+        return len(hist) / (time.perf_counter() - t0)
+
+    off_sim, on_sim = make(base), make(on_cfg)
+    off_rates, on_rates = [], []
+    for rep in range(reps):
+        pair = [(off_sim, off_rates), (on_sim, on_rates)]
+        for sim, rates in pair if rep % 2 == 0 else reversed(pair):
+            rates.append(round(timed_rep(sim), 4))
+
+    # bit-identical params: 3 rounds from the same seed, on vs off
+    state_off, _ = off_sim.run(num_rounds=3, state=off_sim.init_state(),
+                               save_checkpoints=False, verbose=False)
+    state_on, _ = on_sim.run(num_rounds=3, state=on_sim.init_state(),
+                             save_checkpoints=False, verbose=False)
+    out["bit_identical_params"] = bool(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(state_off["global_params"]),
+                        jax.tree.leaves(state_on["global_params"]))))
+    off_sim.close()
+    on_sim.close()
+
+    off_mean = sum(off_rates) / len(off_rates)
+    on_mean = sum(on_rates) / len(on_rates)
+    out["metrics_off"] = {"rounds_per_sec_steady": max(off_rates),
+                          "rounds_per_sec_mean": round(off_mean, 4),
+                          "per_rep": off_rates}
+    out["metrics_on"] = {"rounds_per_sec_steady": max(on_rates),
+                         "rounds_per_sec_mean": round(on_mean, 4),
+                         "per_rep": on_rates}
+    out["overhead_pct"] = round((off_mean - on_mean) / off_mean * 100.0, 2)
+    return out
+
+
 def measure_compile_cache(cfg, n_rounds: int, cache_dir: str) -> dict:
     """First-run vs warm-cache compile cost of the fused round program.
 
@@ -396,6 +480,11 @@ def main() -> None:
                         help="measure ONLY steady-state rounds/s of the "
                              "synchronous default vs pipeline=True + async "
                              "checkpointing on the same config")
+    parser.add_argument("--numerics-overhead", action="store_true",
+                        help="measure ONLY steady-state rounds/s of the "
+                             "pipelined executor with telemetry.numerics "
+                             "off vs on (the in-graph metric set), plus "
+                             "the bit-identical-params check")
     parser.add_argument("--compile-cache", nargs="?", type=str, default=None,
                         const="/tmp/attackfl_compile_cache", metavar="DIR",
                         help="measure ONLY first-run vs warm-cache compile "
@@ -407,12 +496,14 @@ def main() -> None:
 
     if sum(map(bool, (args.config is not None and args.compile_cache is None,
                       args.north_star, args.e2e_rounds is not None,
-                      args.pipeline_compare,
+                      args.pipeline_compare, args.numerics_overhead,
                       args.compile_cache is not None))) > 1:
         parser.error("--config / --north-star / --e2e-rounds / "
-                     "--pipeline-compare / --compile-cache are exclusive")
+                     "--pipeline-compare / --numerics-overhead / "
+                     "--compile-cache are exclusive")
     single = (args.config is not None or args.north_star
               or args.e2e_rounds is not None or args.pipeline_compare
+              or args.numerics_overhead
               or args.compile_cache is not None)
     if not single and (args.backend or args.clients or args.trace or args.dtype
                        or args.hyper_update):
@@ -431,6 +522,8 @@ def main() -> None:
         metric_name = "fl_rounds_per_sec_1000c"
     elif args.pipeline_compare:
         metric_name = "fl_pipeline_vs_sync_rounds_per_sec"
+    elif args.numerics_overhead:
+        metric_name = "fl_numerics_on_rounds_per_sec"
     elif args.compile_cache is not None:
         metric_name = "fl_compile_cache_warm_vs_cold_s"
     elif args.e2e_rounds is not None:
@@ -505,6 +598,19 @@ def main() -> None:
             **{vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4)},
             detail=res,
         )))
+
+    if args.numerics_overhead:
+        deadline_timer.cancel()
+        res = measure_numerics_overhead(args.rounds, "/tmp/attackfl_bench")
+        partial.update(res)
+        print(json.dumps(metric_line(
+            metric_name, res["metrics_on"]["rounds_per_sec_steady"],
+            unit="rounds/s",
+            overhead_pct=res["overhead_pct"],
+            bit_identical_params=res["bit_identical_params"],
+            detail=res,
+        )))
+        return
 
     if args.pipeline_compare:
         deadline_timer.cancel()

@@ -6,10 +6,14 @@ against the telemetry event schema
 Schema v2 aware: per-process multi-host files (``events.<i>.jsonl``) are
 globbed too, and the v2 kinds (``stall``, ``attribution``, ``profile``)
 plus the ``process_index`` envelope field validate through the same
-``validate_event`` the writers use.  v1 artifacts stay green — v2 only
-adds kinds and optional fields.  ``tests/test_event_artifacts.py`` runs
-this over the repo's committed artifacts in tier-1 so schema drift fails
-CI instead of rotting silently.
+``validate_event`` the writers use.  Schema v3 (ISSUE 4) extends
+``metric`` events with optional in-graph numerics payloads
+(``round``/``broadcast``/``numerics``/``hist``), type-checked when
+present.  v1/v2 artifacts stay green — each version only adds kinds and
+optional fields.  ``tests/test_event_artifacts.py`` runs this over the
+repo's committed artifacts (including the v3 corpus
+``tests/data/events.v3.jsonl``) in tier-1 so schema drift fails CI
+instead of rotting silently.
 
 Usage: python scripts/check_event_schema.py [path ...]
 Exit 0 when every line of every found file validates; 1 otherwise.

@@ -5,8 +5,12 @@ materialization of a device value — ``jax.block_until_ready``,
 ``float(...)`` / ``np.asarray(...)`` on an in-flight array,
 ``jax.device_get`` — fences the dispatch queue and serializes device
 compute behind Python.  This lint walks the AST of every module under
-``attackfl_tpu/training/`` and flags those calls anywhere OUTSIDE the
-audited allowlist below, so a new sync can't silently creep back onto
+``attackfl_tpu/training/`` — plus the numerics-engine files
+``ops/metrics.py`` (device-side metric fns, which by contract are
+traced-only: a ``float(...)`` inside one would fence every jitted round)
+and ``telemetry/numerics.py`` (whose drainer owns the subsystem's ONE
+audited device-to-host transfer) — and flags those calls anywhere OUTSIDE
+the audited allowlist below, so a new sync can't silently creep back onto
 the critical path.  It cannot see types, so the allowlist is
 function-granular: a listed function is an audited location where
 materialization is intentional (resolve points, host-side defenses,
@@ -30,6 +34,12 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 TRAINING = REPO / "attackfl_tpu" / "training"
+# the numerics engine (ISSUE 4) is held to the same standard: metric
+# compute fns are traced-only, and exactly one drain transfer is audited
+NUMERICS_FILES = (
+    REPO / "attackfl_tpu" / "ops" / "metrics.py",
+    REPO / "attackfl_tpu" / "telemetry" / "numerics.py",
+)
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -67,6 +77,16 @@ ALLOWED_FUNCTIONS: dict[str, set[str]] = {
     },
     "round.py": {
         "build_round_step",
+    },
+    # telemetry/numerics.py: NumericsDrainer.drain is the numerics
+    # subsystem's SINGLE audited device->host transfer — one np.asarray of
+    # the whole ring buffer, amortized over up to `window` rounds, called
+    # off the dispatch edge (sync path) or at run end.  Everything else in
+    # that file (including _emit_row) handles already-host numpy via
+    # .item() and stays lint-clean; ops/metrics.py is traced-only and has
+    # NO allowlisted functions by design.
+    "numerics.py": {
+        "NumericsDrainer.drain",
     },
 }
 
@@ -130,7 +150,7 @@ def check_file(path: Path) -> list[str]:
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     files = ([Path(a) for a in args] if args
-             else sorted(TRAINING.glob("*.py")))
+             else sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES))
     violations: list[str] = []
     for path in files:
         if not path.exists():

@@ -1,8 +1,8 @@
 """Tier-1 schema smoke over committed telemetry artifacts (ISSUE 2
 satellite): run scripts/check_event_schema.py across the whole repo so any
-events*.jsonl we commit — v1 bench artifacts, the v2 multi-host corpus in
-tests/data — fails CI the moment the schema drifts instead of rotting
-silently.
+events*.jsonl we commit — v1 bench artifacts, the v2 multi-host corpus,
+the v3 numerics corpus in tests/data — fails CI the moment the schema
+drifts instead of rotting silently.
 """
 
 import importlib.util
@@ -28,11 +28,31 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/events.v1.jsonl" in names
     assert "tests/data/multihost/events.0.jsonl" in names
     assert "tests/data/multihost/events.1.jsonl" in names
+    assert "tests/data/events.v3.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 
 def test_v1_artifact_stays_green_standalone():
-    """The explicit backward-compat gate: schema v2 tooling must accept a
+    """The explicit backward-compat gate: schema v3 tooling must accept a
     pure v1 file with zero violations."""
     lint = load_lint()
     assert lint.check_file(REPO / "tests" / "data" / "events.v1.jsonl") == []
+
+
+def test_v3_numerics_artifact_validates_standalone():
+    """The committed v3 corpus (ISSUE 4): `metric` events carrying the
+    in-graph numerics payload (round/broadcast/numerics/hist) validate,
+    and the corpus actually exercises those fields."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v3.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    rows = [e for e in events
+            if e["kind"] == "metric" and e.get("metric") == "numerics"]
+    assert rows, "v3 corpus must contain numerics metric events"
+    assert all(isinstance(e["numerics"], dict) and isinstance(e["hist"], list)
+               and isinstance(e["round"], int) for e in rows)
+    # null gauges (non-finite on device) are part of the v3 contract
+    assert any(v is None for e in rows for v in e["numerics"].values())

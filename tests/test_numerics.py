@@ -1,0 +1,422 @@
+"""In-graph numerics engine (ISSUE 4): device-side metric rows computed
+inside the jitted round and drained without new host syncs.
+
+Covers the acceptance gates: metrics-on vs metrics-off bit-identical
+global params across the synchronous / fused / pipelined executors,
+ring-buffer wraparound + k-late drain ordering, histogram buckets and
+percentiles against numpy on a fixed seed, the hyper-detection forensics
+fold-in, the monitor gauges, and the host-sync lint holding the metric
+fns to their traced-only contract.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config, HyperDetectionConfig
+from attackfl_tpu.ops import metrics as num_metrics
+from attackfl_tpu.telemetry import Counters
+from attackfl_tpu.telemetry.numerics import (
+    NumericsDrainer, format_numerics, numerics_summary,
+)
+from attackfl_tpu.training.engine import Simulator
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128, log_path=".",
+    checkpoint_dir=".",
+)
+
+
+def numerics_on(cfg: Config, **tele) -> Config:
+    return cfg.replace(telemetry=dataclasses.replace(
+        cfg.telemetry, numerics=True, **tele))
+
+
+def _assert_params_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _numerics_events(path) -> list[dict]:
+    events = [json.loads(line) for line in open(path)]
+    return [e for e in events
+            if e["kind"] == "metric" and e.get("metric") == "numerics"]
+
+
+class _RecordingTelemetry:
+    """events.emit -> list, real Counters — enough for the drainer."""
+
+    class _Events:
+        def __init__(self):
+            self.records: list[dict] = []
+
+        def emit(self, kind, **fields):
+            self.records.append(dict(kind=kind, **fields))
+
+    def __init__(self):
+        self.events = self._Events()
+        self.counters = Counters()
+
+
+# ---------------------------------------------------------------------------
+# the tentpole guarantee: metrics never touch the params math
+# ---------------------------------------------------------------------------
+
+
+def test_bit_identical_params_across_all_paths(tmp_path, monkeypatch):
+    """One seeded attacked config, four executions: sync with metrics off
+    (reference) vs sync / pipelined / fused with metrics on.  All three
+    metrics-on paths must produce byte-equal global params AND one
+    numerics event per round, with rows agreeing across paths."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = Config(num_round=3, total_clients=5, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2,
+                                     attack_round=2),), **BASE)
+    ref, _ = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+
+    ncfg = numerics_on(cfg)
+    state_s, hist_s = Simulator(ncfg).run(save_checkpoints=False,
+                                          verbose=False)
+    state_p, hist_p = Simulator(ncfg).run(save_checkpoints=False,
+                                          verbose=False, pipeline=True)
+    sim_f = Simulator(ncfg)
+    state_f, _ = sim_f.run_fast(num_rounds=3)
+    sim_f.close()
+
+    _assert_params_equal(ref["global_params"], state_s["global_params"])
+    _assert_params_equal(ref["global_params"], state_p["global_params"])
+    _assert_params_equal(ref["global_params"], state_f["global_params"])
+    assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p] == [True] * 3
+
+    rows = _numerics_events(tmp_path / "events.jsonl")
+    by_run: dict[str, list[dict]] = {}
+    for event in rows:
+        by_run.setdefault(event["run_id"], []).append(event)
+    assert [len(v) for v in by_run.values()] == [3, 3, 3]
+    runs = list(by_run.values())
+    for per_run in runs:
+        assert [e["round"] for e in per_run] == [1, 2, 3]
+    # same round, same numbers regardless of executor (rows are computed
+    # by different compiled programs, so compare to report precision)
+    for other_ev in runs[1] + runs[2]:
+        sync_row = runs[0][other_ev["round"] - 1]
+        for key, value in other_ev["numerics"].items():
+            expect = sync_row["numerics"][key]
+            if value is None or expect is None:
+                assert value == expect, key
+            else:
+                assert value == pytest.approx(expect, abs=1e-4), key
+        assert other_ev["hist"] == sync_row["hist"]
+    # the attacked rounds actually have a malicious cohort reporting
+    attacked = runs[0][1]["numerics"]
+    assert attacked["update_norm_malicious_p95"] is not None
+    assert attacked["sep_margin"] is not None
+
+
+def test_sync_path_batched_drain_and_run_end_flush(tmp_path, monkeypatch):
+    """numerics_window=2 over 5 rounds: the synchronous path drains in
+    window-sized batches plus a final flush — every round is emitted
+    exactly once, in order, with nothing dropped."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = numerics_on(Config(num_round=5, total_clients=3, mode="fedavg",
+                             validation=False, **BASE),
+                      numerics_window=2)
+    sim = Simulator(cfg)
+    sim.run(save_checkpoints=False, verbose=False)
+    rows = _numerics_events(tmp_path / "events.jsonl")
+    assert [e["round"] for e in rows] == [1, 2, 3, 4, 5]
+    assert [e["broadcast"] for e in rows] == [1, 2, 3, 4, 5]
+    assert sim.telemetry.counters.get("numerics_rows") == 5
+    assert sim.telemetry.counters.get("numerics_rows_dropped") == 0
+    assert sim._numerics_drainer.rows_dropped == 0
+    sim.close()
+
+
+# ---------------------------------------------------------------------------
+# ring buffer: wraparound + k-late drain ordering (drainer unit level)
+# ---------------------------------------------------------------------------
+
+
+def _make_ring(layout, window: int, rounds: int):
+    """Simulate the device side: `rounds` rows written at cursor % window.
+    Row r carries r+1 in its `broadcast` slot so emitted events are
+    traceable back to the round that produced them."""
+    buffer = np.full((window, layout.size), np.nan, np.float32)
+    for r in range(rounds):
+        row = np.full(layout.size, float(r + 1), np.float32)
+        row[layout.index("broadcast")] = r + 1
+        buffer[r % window] = row
+    return {"buffer": buffer}
+
+
+def test_drainer_emits_k_late_in_round_order():
+    layout = num_metrics.build_layout({"w": np.zeros(3)}, False)
+    tel = _RecordingTelemetry()
+    drainer = NumericsDrainer(layout, tel, window=4)
+    for r in range(1, 4):
+        drainer.note_round(r, r)
+    assert drainer.due() is False  # 3 pending < window 4
+    assert drainer.drain(_make_ring(layout, 4, 3)) == 3
+    for r in range(4, 6):
+        drainer.note_round(r, r)
+    assert drainer.drain(_make_ring(layout, 4, 5)) == 2
+    emitted = tel.events.records
+    assert [e["round"] for e in emitted] == [1, 2, 3, 4, 5]
+    # each event came from the ring slot its round actually wrote
+    assert [e["numerics"]["broadcast"] for e in emitted] == [1, 2, 3, 4, 5]
+    assert drainer.rows_dropped == 0
+    assert tel.counters.get("numerics_rows") == 5
+
+
+def test_drainer_wraparound_drops_overwritten_rows():
+    """6 rounds into a window of 4 without an intervening drain: the 2
+    oldest rows were overwritten on device — they are counted as dropped
+    and the 4 surviving rows still emit in round order."""
+    layout = num_metrics.build_layout({"w": np.zeros(3)}, False)
+    tel = _RecordingTelemetry()
+    drainer = NumericsDrainer(layout, tel, window=4)
+    for r in range(1, 7):
+        drainer.note_round(r, r)
+    assert drainer.due() is True
+    assert drainer.drain(_make_ring(layout, 4, 6)) == 4
+    assert drainer.rows_dropped == 2
+    assert tel.counters.get("numerics_rows_dropped") == 2
+    emitted = tel.events.records
+    assert [e["round"] for e in emitted] == [3, 4, 5, 6]
+    assert [e["numerics"]["broadcast"] for e in emitted] == [3, 4, 5, 6]
+    # idempotent once drained
+    assert drainer.drain(_make_ring(layout, 4, 6)) == 0
+
+
+# ---------------------------------------------------------------------------
+# device math vs numpy on a fixed seed
+# ---------------------------------------------------------------------------
+
+
+def test_masked_distribution_matches_numpy_percentiles():
+    rng = np.random.default_rng(7)
+    values = rng.uniform(0.0, 10.0, size=32).astype(np.float32)
+    mask = rng.random(32) < 0.6
+    p50, p95, mx = jax.jit(num_metrics.masked_distribution)(
+        jnp.asarray(values), jnp.asarray(mask))
+    kept = values[mask]
+    np.testing.assert_allclose(float(p50), np.percentile(kept, 50), rtol=1e-5)
+    np.testing.assert_allclose(float(p95), np.percentile(kept, 95), rtol=1e-5)
+    np.testing.assert_allclose(float(mx), kept.max(), rtol=1e-6)
+    # empty cohort -> NaN everywhere, never an exception
+    p50, p95, mx = jax.jit(num_metrics.masked_distribution)(
+        jnp.asarray(values), jnp.zeros(32, bool))
+    assert np.isnan(float(p50)) and np.isnan(float(p95)) and np.isnan(float(mx))
+
+
+def test_histogram_buckets_match_numpy():
+    """Fixed-seed norms spanning the full log range: the in-graph
+    searchsorted histogram equals the numpy reference bucket-for-bucket,
+    and non-reporting clients are excluded."""
+    rng = np.random.default_rng(11)
+    clients, dim = 48, 5
+    stacked = {"w": jnp.asarray(
+        rng.lognormal(mean=0.0, sigma=3.0, size=(clients, dim))
+        .astype(np.float32))}
+    sizes = jnp.asarray((rng.random(clients) < 0.9).astype(np.int32))
+    layout = num_metrics.build_layout({"w": np.zeros(dim)}, False)
+    numerics = num_metrics.Numerics(
+        layout, np.ones(clients, bool), np.zeros(clients, bool), window=4)
+    row = np.asarray(jax.jit(numerics.compute_row)(
+        {"w": jnp.zeros(dim)}, {"w": jnp.zeros(dim)}, {"w": jnp.zeros(dim)},
+        stacked, sizes, jnp.float32(0.5), jnp.float32(0.4),
+        jnp.bool_(True), jnp.int32(1)))
+
+    norms = np.linalg.norm(np.asarray(stacked["w"]), axis=1)
+    reporting = np.asarray(sizes) > 0
+    edges = np.asarray(num_metrics.HIST_EDGES)
+    expected = np.bincount(
+        np.searchsorted(edges, norms[reporting], side="right"),
+        minlength=num_metrics.NUM_HIST_BUCKETS)
+    got = row[len(layout.names):]
+    np.testing.assert_array_equal(got.astype(np.int64), expected)
+    assert int(got.sum()) == int(reporting.sum())
+    # percentile slots agree with numpy over the reporting cohort too
+    np.testing.assert_allclose(
+        row[layout.index("update_norm_all_p50")],
+        np.percentile(norms[reporting], 50), rtol=1e-4)
+
+
+def test_nonfinite_provenance_points_at_first_bad_leaf():
+    layout = num_metrics.build_layout(
+        {"a": np.zeros(2), "b": np.zeros(3)}, False)
+    numerics = num_metrics.Numerics(
+        layout, np.ones(4, bool), np.zeros(4, bool), window=4)
+    stacked = {"a": jnp.ones((4, 2)),
+               "b": jnp.ones((4, 3)).at[2, 1].set(jnp.nan)
+                                     .at[2, 2].set(jnp.inf)}
+    zeros = {"a": jnp.zeros(2), "b": jnp.zeros(3)}
+    row = np.asarray(jax.jit(numerics.compute_row)(
+        zeros, zeros, zeros, stacked,
+        jnp.ones(4, jnp.int32), jnp.float32(0.5), jnp.float32(0.4),
+        jnp.bool_(True), jnp.int32(1)))
+    # provenance is at (client, layer) granularity: client 2's NaN and
+    # Inf both live in leaf "b" -> one poisoned block
+    assert row[layout.index("nonfinite_count")] == 1
+    assert row[layout.index("nonfinite_clients")] == 1
+    leaf = int(row[layout.index("first_nonfinite_leaf")])
+    assert layout.leaf_names[leaf] == "b"
+    # the poisoned client is excluded from cohort stats, not poisoning them
+    assert np.isfinite(row[layout.index("update_norm_all_max")])
+
+
+# ---------------------------------------------------------------------------
+# hyper mode: numerics + detection forensics fold-in
+# ---------------------------------------------------------------------------
+
+
+def test_hyper_numerics_and_detection_forensics(tmp_path, monkeypatch):
+    """Hyper mode with detection on: params stay bit-identical with
+    numerics enabled, every round emits a numerics row, and the detector's
+    verdicts land as `attribution` events scored by `metrics
+    --forensics`."""
+    from attackfl_tpu.telemetry.forensics import forensics_summary
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = Config(num_round=2, total_clients=3, mode="hyper",
+                 attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                     attack_round=2),),
+                 hyper_detection=HyperDetectionConfig(enable=True), **BASE)
+    ref, _ = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    state, hist = Simulator(numerics_on(cfg)).run(save_checkpoints=False,
+                                                  verbose=False)
+    _assert_params_equal(ref["hnet_params"], state["hnet_params"])
+
+    events = [json.loads(line) for line in open(tmp_path / "events.jsonl")]
+    rows = [e for e in events
+            if e["kind"] == "metric" and e.get("metric") == "numerics"]
+    assert [e["round"] for e in rows] == [1, 2]
+    attr = [e for e in events if e["kind"] == "attribution"]
+    assert attr and all(e["source"] == "hyper_detection" for e in attr)
+    assert all("scores" in e for e in attr)
+    summary = forensics_summary(events)
+    assert summary is not None
+    assert summary["source"] == "hyper_detection"
+    assert summary["rounds"] == len(attr)
+
+
+# ---------------------------------------------------------------------------
+# monitor gauges + report plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_surfaces_numerics_gauges(tmp_path):
+    from attackfl_tpu.telemetry import EventLog, NullTracer, Telemetry
+    from attackfl_tpu.telemetry.monitor import RunMonitor
+
+    tel = Telemetry(EventLog(str(tmp_path / "events.jsonl")), NullTracer(),
+                    Counters(), True, base_dir=str(tmp_path))
+    mon = RunMonitor(tel, port=0, poll_interval=3600)
+    mon.record_round({"round": 1, "broadcast": 1, "ok": True, "seconds": 0.1})
+    mon.update_numerics({"update_norm_all_p95": 2.5, "nonfinite_count": 0.0,
+                         "sep_margin": None})
+    last = mon.last_round()
+    assert last["numerics"] == {"update_norm_all_p95": 2.5,
+                                "nonfinite_count": 0.0}  # None filtered
+    text = mon.metrics_text()
+    assert 'attackfl_numerics{name="update_norm_all_p95"} 2.5' in text
+    assert "sep_margin" not in text
+
+
+def test_watch_prints_numerics_gauges(tmp_path, capsys):
+    from attackfl_tpu import cli
+    from attackfl_tpu.telemetry import EventLog, NullTracer, Telemetry
+    from attackfl_tpu.telemetry.monitor import RunMonitor
+
+    tel = Telemetry(EventLog(str(tmp_path / "events.jsonl")), NullTracer(),
+                    Counters(), True, base_dir=str(tmp_path))
+    mon = RunMonitor(tel, port=0, poll_interval=3600)
+    mon.start()
+    try:
+        mon.run_started()
+        mon.record_round({"round": 2, "broadcast": 2, "ok": True,
+                          "seconds": 0.1, "roc_auc": 0.9})
+        mon.update_numerics({"update_norm_all_p95": 2.51,
+                             "nonfinite_count": 0.0, "sep_margin": -0.12})
+        assert cli.watch_main(
+            [f"http://127.0.0.1:{mon.port}", "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "unorm_p95=2.51" in out
+        assert "nonfinite=0" in out
+        assert "sep=-0.12" in out
+    finally:
+        mon.stop()
+
+
+def test_numerics_summary_dedups_and_formats():
+    def event(broadcast, run_id="r0", **gauges):
+        base = {"update_norm_all_p95": 1.5, "nonfinite_count": 0.0,
+                "sep_margin": 0.25, "sep_cosine": 0.1, "sep_l2": 2.0}
+        base.update(gauges)
+        return {"kind": "metric", "metric": "numerics", "run_id": run_id,
+                "round": broadcast, "broadcast": broadcast,
+                "numerics": base, "hist": [0] * 16}
+
+    events = [event(1), event(2, nonfinite_count=3.0, sep_margin=None,
+                             sep_cosine=None, sep_l2=None),
+              event(1)]  # duplicate broadcast (second process) — deduped
+    summary = numerics_summary(events)
+    assert summary["rounds"] == 2
+    assert summary["nonfinite_total"] == 3
+    assert summary["separation"]["rounds"] == 1
+    assert summary["separation"]["margin_mean"] == 0.25
+    text = format_numerics(summary, "r0")
+    assert "rounds with numerics: 2" in text
+    assert "attack separation over 1 round(s)" in text
+
+    assert numerics_summary([{"kind": "round"}]) is None
+
+
+# ---------------------------------------------------------------------------
+# host-sync lint: the metric fns are held to a traced-only contract
+# ---------------------------------------------------------------------------
+
+
+def _load_sync_lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_host_sync", REPO / "scripts" / "check_host_sync.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_metric_fn_with_in_graph_float_fails_lint(tmp_path):
+    """Regression gate for the lint itself: a metric fn that materializes
+    a device value (float(...) inside compute_row) is flagged — metrics.py
+    has NO allowlisted functions by design."""
+    lint = _load_sync_lint()
+    bad = tmp_path / "metrics.py"
+    bad.write_text(
+        "def compute_row(self, norms):\n"
+        "    return float(norms.mean())\n")
+    violations = lint.check_file(bad)
+    assert len(violations) == 1 and "float" in violations[0]
+
+
+def test_numerics_files_are_linted_by_default_and_clean():
+    lint = _load_sync_lint()
+    assert lint.check_file(
+        REPO / "attackfl_tpu" / "ops" / "metrics.py") == []
+    assert lint.check_file(
+        REPO / "attackfl_tpu" / "telemetry" / "numerics.py") == []
+    # and the default scan actually covers them (not just when named)
+    names = {p.name for p in lint.NUMERICS_FILES}
+    assert names == {"metrics.py", "numerics.py"}
+    # only the drainer's single batched transfer is allowlisted
+    assert lint.ALLOWED_FUNCTIONS["numerics.py"] == {"NumericsDrainer.drain"}
+    assert "metrics.py" not in lint.ALLOWED_FUNCTIONS
