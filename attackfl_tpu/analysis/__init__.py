@@ -1,0 +1,33 @@
+"""Static-analysis subsystem (ISSUE 5): machine-checked guarantees over
+the invariants the framework's performance claims rest on.
+
+* :mod:`~attackfl_tpu.analysis.registry` — the lint framework: rule
+  registry, audit context, structured findings.
+* :mod:`~attackfl_tpu.analysis.ast_rules` — source-level rules: host-sync
+  (with live allowlist resolution), donation-after-use, retrace-hazard,
+  emit-kind.
+* :mod:`~attackfl_tpu.analysis.artifacts` — event-schema validation of
+  committed telemetry JSONL.
+* :mod:`~attackfl_tpu.analysis.program_audit` — jaxpr/HLO invariants of
+  the compiled round programs (no callbacks, donation aliasing, dtype
+  discipline, transfer budget).
+* :mod:`~attackfl_tpu.analysis.retrace` — the dynamic retrace guard.
+* :mod:`~attackfl_tpu.analysis.cli` — the ``attackfl-tpu audit`` entry
+  point.
+
+``scripts/check_host_sync.py`` and ``scripts/check_event_schema.py`` are
+thin shims over this package.
+"""
+
+from attackfl_tpu.analysis.findings import Finding, sort_findings
+from attackfl_tpu.analysis.registry import (
+    AuditContext, Rule, describe_rules, load_rules, run_rules)
+
+__all__ = [
+    "AuditContext",
+    "Finding",
+    "Rule",
+    "describe_rules",
+    "load_rules",
+    "run_rules",
+]

@@ -1,0 +1,102 @@
+"""Event-schema artifact rule: every committed ``events*.jsonl`` validates.
+
+Migrated from ``scripts/check_event_schema.py`` (ISSUE 1/2 satellites; the
+script is now a shim over this module).  Schema v2 aware: per-process
+multi-host files (``events.<i>.jsonl``) are globbed too, and v2/v3 kinds
+and optional fields validate through the same
+:func:`attackfl_tpu.telemetry.events.validate_event` the writers use, so
+tooling and writers cannot disagree.  v1 artifacts stay green — each
+schema version only adds kinds and optional fields.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from attackfl_tpu.analysis.findings import Finding, relativize
+from attackfl_tpu.analysis.registry import AuditContext, register
+
+EVENT_SCHEMA_HINT = (
+    "regenerate the artifact with the current writers, or — for a new "
+    "kind/field — extend REQUIRED_FIELDS in telemetry/events.py and bump "
+    "SCHEMA_VERSION")
+
+
+def find_event_files(path: Path) -> list[Path]:
+    path = Path(path)
+    if path.is_file():
+        return [path]
+    return sorted(set(path.rglob("events.jsonl")) |
+                  set(path.rglob("events.*.jsonl")) |
+                  set(path.rglob("*.events.jsonl")))
+
+
+def event_schema_findings(path: Path, root: Path | None = None) -> list[Finding]:
+    """Validate one JSONL file; one finding per invalid line/field."""
+    from attackfl_tpu.telemetry.events import validate_event
+
+    path = Path(path)
+    rel = relativize(path, root) if root is not None else str(path)
+    findings: list[Finding] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as e:
+                findings.append(Finding(
+                    rule="event-schema", file=rel, line=lineno,
+                    message=f"not JSON ({e})", hint=EVENT_SCHEMA_HINT))
+                continue
+            for problem in validate_event(record):
+                findings.append(Finding(
+                    rule="event-schema", file=rel, line=lineno,
+                    message=problem, hint=EVENT_SCHEMA_HINT))
+    return findings
+
+
+@register(
+    "event-schema",
+    "every committed events*.jsonl line validates against the telemetry "
+    "event schema (telemetry/events.py validate_event)",
+    EVENT_SCHEMA_HINT,
+)
+def _event_schema_rule(ctx: AuditContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in find_event_files(ctx.root):
+        findings.extend(event_schema_findings(path, ctx.root))
+    return findings
+
+
+# --- scripts/check_event_schema.py shim compatibility ----------------------
+
+def event_schema_check_file(path: Path) -> list[str]:
+    """Old lint output format: ``path:line: problem`` strings."""
+    return [f"{f.file}:{f.line}: {f.message}"
+            for f in event_schema_findings(Path(path))]
+
+
+def event_schema_main(argv: list[str] | None = None) -> int:
+    """Old CLI behavior (scripts/check_event_schema.py)."""
+    import sys
+
+    repo = Path(__file__).resolve().parent.parent.parent
+    args = list(sys.argv[1:] if argv is None else argv)
+    roots = [Path(a) for a in args] or [repo]
+    files: list[Path] = []
+    for root in roots:
+        if not root.exists():
+            print(f"error: no such path {root}", file=sys.stderr)
+            return 1
+        files.extend(find_event_files(root))
+    errors: list[str] = []
+    for path in files:
+        errors.extend(event_schema_check_file(path))
+    for problem in errors:
+        print(problem)
+    print(f"checked {len(files)} file(s): "
+          f"{'OK' if not errors else f'{len(errors)} schema violation(s)'}")
+    return 1 if errors else 0

@@ -1,0 +1,707 @@
+"""AST rules: the source-level half of the graph auditor.
+
+Four rules over the package's Python sources:
+
+* ``host-sync`` — no host-device sync barrier (``block_until_ready``,
+  ``float(...)``, ``np.asarray``/``np.array``, ``device_get``) on the
+  training hot path outside the audited allowlist.  Migrated from
+  ``scripts/check_host_sync.py`` (ISSUE 3), which is now a shim over this
+  module.  The allowlist is *resolved against the live modules* at lint
+  time: an allowlisted qualified name that no longer exists (renamed,
+  deleted) is itself a finding, so the audited-transfer budget can't
+  silently drift from the code it audits.
+* ``donation-after-use`` — a buffer donated to a jitted program
+  (``jax.jit(..., donate_argnums=...)``) is read again after the donating
+  call.  Donated buffers are invalidated by dispatch; re-reading one is a
+  runtime ``RuntimeError`` on real hardware and silent wrong-buffer reuse
+  at worst.  Only *literal* donate_argnums are tracked — conditional
+  donation (``() if cond else (1,)``, the engine's numerics-aware policy)
+  is a host-level decision the jaxpr auditor covers instead.
+* ``retrace-hazard`` — patterns that make a jitted program retrace after
+  round 1: ``jax.jit`` inside a loop (a fresh program per iteration),
+  Python scalar conversions (``float()``/``int()``) flowing into a
+  ``static_argnums`` position (a fresh signature per value), and
+  iteration over a ``set`` (nondeterministic order feeding program
+  structure — a persistent-compile-cache miss across processes).
+* ``emit-kind`` — every ``.emit("<kind>", ...)`` literal exists in the
+  telemetry schema for the version it targets
+  (:data:`attackfl_tpu.telemetry.events.KINDS_BY_VERSION`), so a typo'd
+  event kind fails the audit instead of producing forever-invalid JSONL.
+
+Every check is also exposed as a per-file function so tests can run it on
+fixture files with seeded violations and assert exact rule id + line.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+from pathlib import Path
+
+from attackfl_tpu.analysis.findings import Finding, relativize
+from attackfl_tpu.analysis.registry import AuditContext, register
+
+# ---------------------------------------------------------------------------
+# host-sync (migrated from scripts/check_host_sync.py — ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+REPO = Path(__file__).resolve().parent.parent.parent
+TRAINING = REPO / "attackfl_tpu" / "training"
+# the numerics engine (ISSUE 4) is held to the same standard: metric
+# compute fns are traced-only, and exactly one drain transfer is audited
+NUMERICS_FILES = (
+    REPO / "attackfl_tpu" / "ops" / "metrics.py",
+    REPO / "attackfl_tpu" / "telemetry" / "numerics.py",
+)
+
+# Call shapes that materialize device values on host.
+SYNC_ATTRS = {"block_until_ready", "device_get"}
+SYNC_NAMES = {"float"}
+SYNC_NP_ATTRS = {"asarray", "array"}
+NP_MODULES = {"np", "numpy"}
+
+# file -> audited functions (qualified as Class.method for methods).
+# Every entry is a deliberate materialization point:
+#   - _run_plain_round / _run_hyper_round: the synchronous path's round
+#     gate (train ok flag, host-side gmm/fltracer defenses, loss print)
+#   - _emit_attribution: forensics read the defense verdict per round
+#   - _resolve_pipeline_round / _resolve_inflight_validations: the
+#     pipelined path's designated one-round-late resolve points
+#   - run_fast: per-chunk materialization of the fused scan's metrics
+#   - _save_checkpoint (via checkpoint.host_state): the device->host
+#     gather deliberately stays on the round loop (ISSUE 3 tentpole)
+#   - _init_host_state / __init__: np.asarray on host-Python constants
+#     and raw dataset numpy (not device values) while building templates
+#   - run_scan: one pre-dispatch guard materializing a resumed state's
+#     active_mask (once per scan call, not per round)
+#   - round.py build_round_step: float() on a host model attribute at
+#     program-build time
+#   - numerics.py NumericsDrainer.drain: the numerics subsystem's SINGLE
+#     audited device->host transfer — one np.asarray of the whole ring
+#     buffer, amortized over up to `window` rounds (ops/metrics.py is
+#     traced-only and has NO allowlisted functions by design)
+ALLOWED_FUNCTIONS: dict[str, set[str]] = {
+    "engine.py": {
+        "Simulator.__init__",
+        "Simulator._run_plain_round",
+        "Simulator._run_hyper_round",
+        "Simulator._emit_attribution",
+        "Simulator._resolve_pipeline_round",
+        "Simulator._resolve_inflight_validations",
+        "Simulator.run_fast",
+        "Simulator.run_scan",
+        "Simulator._init_host_state",
+    },
+    "round.py": {
+        "build_round_step",
+    },
+    "numerics.py": {
+        "NumericsDrainer.drain",
+    },
+}
+
+# basename -> live module the allowlist entries must resolve against.
+# Resolution (resolve_host_sync_allowlist) runs on every lint/audit so a
+# rename of an audited function fails loudly instead of leaving a dead
+# allowlist entry that would green-light a NEW sync under the old name.
+ALLOWLIST_MODULES: dict[str, str] = {
+    "engine.py": "attackfl_tpu.training.engine",
+    "round.py": "attackfl_tpu.training.round",
+    "numerics.py": "attackfl_tpu.telemetry.numerics",
+}
+
+HOST_SYNC_HINT = (
+    "move the materialization into an audited resolve function, or add the "
+    "function to ALLOWED_FUNCTIONS in attackfl_tpu/analysis/ast_rules.py "
+    "WITH a comment saying why it must block (allowlist entries are "
+    "resolved against the live module, so they cannot outlive the code)")
+
+
+def _qualname(stack: list[str]) -> str:
+    return ".".join(stack) if stack else "<module>"
+
+
+def _sync_call_name(node: ast.Call) -> str | None:
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in SYNC_NAMES:
+        return func.id
+    if isinstance(func, ast.Attribute):
+        if func.attr in SYNC_ATTRS:
+            return func.attr
+        if (func.attr in SYNC_NP_ATTRS and isinstance(func.value, ast.Name)
+                and func.value.id in NP_MODULES):
+            return f"{func.value.id}.{func.attr}"
+    return None
+
+
+class _SyncFinder(ast.NodeVisitor):
+    def __init__(self, allowed: set[str]):
+        self.allowed = allowed
+        self.stack: list[str] = []
+        self.hits: list[tuple[int, str, str]] = []  # (line, call, qualname)
+
+    def _visit_scope(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _sync_call_name(node)
+        if name is not None:
+            # qualify against the nearest class.method / function pair so
+            # nested closures inherit their enclosing function's audit
+            qual = _qualname(self.stack[:2])
+            if qual not in self.allowed:
+                self.hits.append((node.lineno, name, qual))
+        self.generic_visit(node)
+
+
+def host_sync_findings(path: Path, tree: ast.Module | None = None,
+                       root: Path = REPO) -> list[Finding]:
+    """Host-sync violations in one file (allowlist keyed by basename, as
+    fixture tests rely on)."""
+    path = Path(path)
+    finder = _SyncFinder(ALLOWED_FUNCTIONS.get(path.name, set()))
+    finder.visit(tree if tree is not None
+                 else ast.parse(path.read_text(), filename=str(path)))
+    return [
+        Finding(rule="host-sync", file=relativize(path, root), line=line,
+                message=f"host sync `{name}` in {qual} — materializes a "
+                        "device value on the round hot path",
+                hint=HOST_SYNC_HINT)
+        for line, name, qual in finder.hits
+    ]
+
+
+def resolve_host_sync_allowlist() -> list[Finding]:
+    """Resolve every allowlist entry against the live module (the
+    audited-allowlist drift check).  A missing symbol is an error finding
+    pointing at the allowlist itself."""
+    findings: list[Finding] = []
+    here = relativize(Path(__file__), REPO)
+    for basename, quals in ALLOWED_FUNCTIONS.items():
+        module_name = ALLOWLIST_MODULES.get(basename)
+        if module_name is None:
+            findings.append(Finding(
+                rule="host-sync", file=here, line=0,
+                message=f"allowlist file {basename!r} has no live-module "
+                        "mapping in ALLOWLIST_MODULES",
+                hint="add the module path so entries can be resolved"))
+            continue
+        try:
+            module = importlib.import_module(module_name)
+        except Exception as e:  # noqa: BLE001 — import failure IS drift
+            findings.append(Finding(
+                rule="host-sync", file=here, line=0,
+                message=f"allowlist module {module_name} failed to import: "
+                        f"{type(e).__name__}: {e}",
+                hint="fix the module or drop its allowlist entries"))
+            continue
+        for qual in sorted(quals):
+            obj = module
+            for part in qual.split("."):
+                obj = getattr(obj, part, None)
+                if obj is None:
+                    break
+            if obj is None:
+                findings.append(Finding(
+                    rule="host-sync", file=here, line=0,
+                    message=f"audited allowlist entry {qual!r} no longer "
+                            f"exists in {module_name} — the allowlist has "
+                            "drifted from the code it audits",
+                    hint="remove the stale entry, or re-point it at the "
+                         "renamed audited function (with its comment)"))
+    return findings
+
+
+def host_sync_files() -> list[Path]:
+    return sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
+
+
+@register(
+    "host-sync",
+    "no host-device sync (block_until_ready / float / np.asarray / "
+    "device_get) on the training hot path outside the audited allowlist; "
+    "allowlist entries must resolve against the live module",
+    HOST_SYNC_HINT,
+)
+def _host_sync_rule(ctx: AuditContext) -> list[Finding]:
+    findings = resolve_host_sync_allowlist()
+    for path in host_sync_files():
+        findings.extend(host_sync_findings(path, ctx.tree(path), ctx.root))
+    return findings
+
+
+# --- scripts/check_host_sync.py shim compatibility -------------------------
+
+def host_sync_check_file(path: Path) -> list[str]:
+    """Old lint output format: one string per violation (kept verbatim for
+    the shim + tests/test_host_sync_lint.py)."""
+    path = Path(path)
+    finder = _SyncFinder(ALLOWED_FUNCTIONS.get(path.name, set()))
+    finder.visit(ast.parse(path.read_text(), filename=str(path)))
+    return [
+        f"{path}:{line}: host sync `{name}` in {qual} — materializes a "
+        "device value on the round hot path (see scripts/check_host_sync.py)"
+        for line, name, qual in finder.hits
+    ]
+
+
+def host_sync_main(argv: list[str] | None = None) -> int:
+    """Old CLI behavior (scripts/check_host_sync.py), plus the live
+    allowlist resolution: stale audited symbols fail the lint."""
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    files = [Path(a) for a in args] if args else host_sync_files()
+    violations: list[str] = []
+    if not args:  # full-tree runs also verify the allowlist is live
+        violations.extend(f.format() for f in resolve_host_sync_allowlist())
+    for path in files:
+        if not path.exists():
+            print(f"error: no such file {path}", file=sys.stderr)
+            return 1
+        violations.extend(host_sync_check_file(path))
+    for line in violations:
+        print(line)
+    print(f"checked {len(files)} file(s): "
+          f"{'OK' if not violations else f'{len(violations)} host sync(s)'}")
+    return 1 if violations else 0
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+DONATION_HINT = (
+    "re-order so the donating call is the LAST consumer of the buffer, "
+    "rebind the name from the call's result, or drop donate_argnums for "
+    "this argument (donation is an optimization hint, never semantics)")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains; None otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _literal_argnums(node: ast.AST | None,
+                     consts: dict[str, tuple[int, ...]] | None = None
+                     ) -> tuple[int, ...] | None:
+    """Literal donate_argnums/static_argnums: int, tuple of ints, or a
+    module-level constant bound to one (e.g. ``EPOCH_DONATE_ARGNUMS``).
+    Conditional / computed expressions return None (not tracked)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Name) and consts:
+        return consts.get(node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _module_const_argnums(tree: ast.Module) -> dict[str, tuple[int, ...]]:
+    """Top-level ``NAME = <int or tuple-of-int literal>`` bindings, so a
+    donation/static policy named as a module constant stays trackable."""
+    consts: dict[str, tuple[int, ...]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            value = _literal_argnums(node.value)
+            if value is not None:
+                consts[node.targets[0].id] = value
+    return consts
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The Call node when ``node`` is ``jax.jit(...)`` / ``jit(...)``."""
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+def _jit_kwarg(call: ast.Call, kwarg: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == kwarg:
+            return kw.value
+    return None
+
+
+class _ScopeWalker(ast.NodeVisitor):
+    """Shared qualname-stack visitor for the donation / retrace scanners."""
+
+    def __init__(self):
+        self.stack: list[str] = []
+
+    def scope(self) -> str:
+        return ".".join(self.stack)
+
+    def _visit_scope(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+
+class _DonatingDefs(_ScopeWalker):
+    """Pass 1: names bound to ``jax.jit(..., donate_argnums=<literal>)``.
+
+    Records ``(scope, dotted_target) -> argnums``; ``self.x`` targets are
+    visible module-wide, bare names only within their defining scope (and
+    nested closures) — so a local ``fn`` in one method can't shadow-track
+    an unrelated ``fn`` in another.
+    """
+
+    def __init__(self, consts: dict[str, tuple[int, ...]] | None = None):
+        super().__init__()
+        self.consts = consts or {}
+        self.defs: dict[str, tuple[str, tuple[int, ...]]] = {}
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        call = _jit_call(node.value)
+        if call is not None:
+            argnums = _literal_argnums(_jit_kwarg(call, "donate_argnums"),
+                                       self.consts)
+            if argnums:
+                for target in node.targets:
+                    name = _dotted(target)
+                    if name:
+                        scope = "" if name.startswith("self.") else self.scope()
+                        self.defs[name] = (scope, argnums)
+        self.generic_visit(node)
+
+
+class _DonationUseScanner(_ScopeWalker):
+    """Pass 2: calls of donating callables, then later loads of the
+    donated argument names within the same function."""
+
+    def __init__(self, defs: dict[str, tuple[str, tuple[int, ...]]],
+                 consts: dict[str, tuple[int, ...]] | None = None):
+        super().__init__()
+        self.defs = defs
+        self.consts = consts or {}
+        self.hits: list[tuple[int, str, str, int]] = []
+        # (use_line, donated_name, callee, call_line)
+
+    def _donating_call(self, call: ast.Call) -> tuple[str, tuple[int, ...]] | None:
+        # direct form: jax.jit(f, donate_argnums=...)(args)
+        inner = _jit_call(call.func)
+        if inner is not None:
+            argnums = _literal_argnums(_jit_kwarg(inner, "donate_argnums"),
+                                       self.consts)
+            if argnums:
+                return ("jax.jit(...)", argnums)
+        name = _dotted(call.func)
+        if name is None:
+            return None
+        rec = self.defs.get(name)
+        if rec is None:
+            return None
+        def_scope, argnums = rec
+        scope = self.scope()
+        if def_scope and not (scope == def_scope
+                              or scope.startswith(def_scope + ".")):
+            return None  # a different function's local name
+        return (name, argnums)
+
+    def _function_scope(self, fn_node: ast.AST) -> None:
+        """Analyze one function body: every donating call's donated names
+        vs. subsequent loads/stores of those names."""
+        calls: list[tuple[ast.Call, str, list[str]]] = []
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Call):
+                rec = self._donating_call(node)
+                if rec is None:
+                    continue
+                callee, argnums = rec
+                donated = []
+                for i in argnums:
+                    if i < len(node.args):
+                        name = _dotted(node.args[i])
+                        if name:
+                            donated.append(name)
+                if donated:
+                    calls.append((node, callee, donated))
+        if not calls:
+            return
+        # name -> store lines across the function body (a rebind after the
+        # donating call makes subsequent loads refer to the new buffer)
+        stores: dict[str, list[int]] = {}
+        inside_call: dict[int, set[int]] = {}
+        for call, _, _ in calls:
+            inside_call.setdefault(id(call), set()).update(
+                id(n) for n in ast.walk(call))
+        for node in ast.walk(fn_node):
+            name = _dotted(node)
+            if name is not None and isinstance(getattr(node, "ctx", None),
+                                               ast.Store):
+                stores.setdefault(name, []).append(node.lineno)
+        # loads are re-walked per call with node identity so arguments of
+        # the donating call itself (which may span lines) are excluded
+        for call, callee, donated in calls:
+            call_ids = inside_call[id(call)]
+            end = getattr(call, "end_lineno", call.lineno)
+            for name in donated:
+                rebinds = [s for s in stores.get(name, [])
+                           if s >= call.lineno]
+                first_rebind = min(rebinds) if rebinds else None
+                for node in ast.walk(fn_node):
+                    if id(node) in call_ids:
+                        continue
+                    if _dotted(node) != name:
+                        continue
+                    if not isinstance(getattr(node, "ctx", None), ast.Load):
+                        continue
+                    if node.lineno <= end:
+                        continue
+                    if first_rebind is not None and node.lineno > first_rebind:
+                        continue
+                    self.hits.append((node.lineno, name, callee, call.lineno))
+                    break  # one finding per (call, name) is enough
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node.name)
+        self._function_scope(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def donation_after_use_findings(path: Path, tree: ast.Module | None = None,
+                                root: Path = REPO) -> list[Finding]:
+    tree = tree if tree is not None else ast.parse(Path(path).read_text(),
+                                                  filename=str(path))
+    consts = _module_const_argnums(tree)
+    defs = _DonatingDefs(consts)
+    defs.visit(tree)
+    scanner = _DonationUseScanner(defs.defs, consts)
+    scanner.visit(tree)
+    rel = relativize(path, root)
+    return [
+        Finding(rule="donation-after-use", file=rel, line=use_line,
+                message=f"`{name}` is read after being donated to "
+                        f"{callee} at line {call_line} — the donated "
+                        "buffer is invalidated by that dispatch",
+                hint=DONATION_HINT)
+        for use_line, name, callee, call_line in sorted(scanner.hits)
+    ]
+
+
+@register(
+    "donation-after-use",
+    "a buffer donated via jax.jit(donate_argnums=...) must not be read "
+    "after the donating call (training/ and ops/)",
+    DONATION_HINT,
+)
+def _donation_rule(ctx: AuditContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for sub in ("training", "ops"):
+        for path in sorted((ctx.package / sub).glob("*.py")):
+            findings.extend(
+                donation_after_use_findings(path, ctx.tree(path), ctx.root))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+RETRACE_HINT = (
+    "hoist jax.jit out of the loop (cache the jitted callable), pass "
+    "traced arrays instead of fresh Python scalars at static positions, "
+    "and sort any set before it shapes a jitted program")
+
+
+class _RetraceScanner(_ScopeWalker):
+    def __init__(self):
+        super().__init__()
+        self.loop_depth = 0
+        self.hits: list[tuple[int, str]] = []
+        # bare jitted names with literal static_argnums, per scope
+        self.static_defs: dict[str, tuple[str, tuple[int, ...]]] = {}
+
+    def _visit_loop(self, node) -> None:
+        self._check_iter(getattr(node, "iter", None))
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def visit_While(self, node: ast.While) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def _check_iter(self, it: ast.AST | None) -> None:
+        if it is None:
+            return
+        is_set = isinstance(it, ast.Set) or (
+            isinstance(it, ast.Call) and _dotted(it.func) == "set")
+        if is_set:
+            self.hits.append((
+                it.lineno,
+                "iteration over a set: nondeterministic order can reshape "
+                "a jitted program between processes/runs (retrace + "
+                "persistent-compile-cache miss)"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        call = _jit_call(node.value)
+        if call is not None:
+            argnums = _literal_argnums(_jit_kwarg(call, "static_argnums"))
+            if argnums:
+                for target in node.targets:
+                    name = _dotted(target)
+                    if name:
+                        scope = "" if name.startswith("self.") else self.scope()
+                        self.static_defs[name] = (scope, argnums)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _jit_call(node) is not None and self.loop_depth > 0:
+            self.hits.append((
+                node.lineno,
+                "jax.jit inside a loop: every iteration builds a fresh "
+                "program (guaranteed retrace; the jit cache is per "
+                "callable object)"))
+        # Python scalar conversion flowing into a static position
+        name = _dotted(node.func)
+        rec = self.static_defs.get(name) if name else None
+        if rec is not None:
+            def_scope, argnums = rec
+            scope = self.scope()
+            if not def_scope or scope == def_scope or \
+                    scope.startswith(def_scope + "."):
+                for i in argnums:
+                    if i < len(node.args):
+                        arg = node.args[i]
+                        if (isinstance(arg, ast.Call)
+                                and _dotted(arg.func) in ("float", "int")):
+                            self.hits.append((
+                                arg.lineno,
+                                f"Python scalar `{_dotted(arg.func)}(...)` "
+                                f"at static_argnums position {i} of "
+                                f"{name}: every distinct value is a new "
+                                "signature (retrace per round)"))
+        self.generic_visit(node)
+
+
+def retrace_hazard_findings(path: Path, tree: ast.Module | None = None,
+                            root: Path = REPO) -> list[Finding]:
+    tree = tree if tree is not None else ast.parse(Path(path).read_text(),
+                                                  filename=str(path))
+    scanner = _RetraceScanner()
+    scanner.visit(tree)
+    rel = relativize(path, root)
+    return [Finding(rule="retrace-hazard", file=rel, line=line,
+                    message=message, hint=RETRACE_HINT)
+            for line, message in sorted(scanner.hits)]
+
+
+@register(
+    "retrace-hazard",
+    "no pattern that retraces a jitted program after round 1: jit-in-loop, "
+    "Python scalars into static_argnums, set-order-dependent structure",
+    RETRACE_HINT,
+)
+def _retrace_rule(ctx: AuditContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in ctx.package_sources():
+        findings.extend(
+            retrace_hazard_findings(path, ctx.tree(path), ctx.root))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# emit-kind
+# ---------------------------------------------------------------------------
+
+EMIT_KIND_HINT = (
+    "fix the typo, or add the new kind to REQUIRED_FIELDS and "
+    "KINDS_BY_VERSION in attackfl_tpu/telemetry/events.py (bump the "
+    "schema version when the kind is new)")
+
+
+class _EmitKindScanner(ast.NodeVisitor):
+    def __init__(self, known: frozenset[str]):
+        self.known = known
+        self.hits: list[tuple[int, str]] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "emit":
+            kind_node: ast.AST | None = node.args[0] if node.args else None
+            if kind_node is None:
+                kind_node = next((kw.value for kw in node.keywords
+                                  if kw.arg == "kind"), None)
+            if (isinstance(kind_node, ast.Constant)
+                    and isinstance(kind_node.value, str)
+                    and kind_node.value not in self.known):
+                self.hits.append((kind_node.lineno, kind_node.value))
+        self.generic_visit(node)
+
+
+def emit_kind_findings(path: Path, tree: ast.Module | None = None,
+                       root: Path = REPO,
+                       known: frozenset[str] | None = None) -> list[Finding]:
+    if known is None:
+        from attackfl_tpu.telemetry.events import known_kinds
+
+        known = known_kinds()
+    tree = tree if tree is not None else ast.parse(Path(path).read_text(),
+                                                  filename=str(path))
+    scanner = _EmitKindScanner(known)
+    scanner.visit(tree)
+    rel = relativize(path, root)
+    return [
+        Finding(rule="emit-kind", file=rel, line=line,
+                message=f"emit kind {kind!r} is not in the telemetry "
+                        f"schema (known kinds: {', '.join(sorted(known))})",
+                hint=EMIT_KIND_HINT)
+        for line, kind in sorted(scanner.hits)
+    ]
+
+
+@register(
+    "emit-kind",
+    "every .emit(\"<kind>\") literal exists in the telemetry event schema "
+    "for the targeted version (telemetry/events.py KINDS_BY_VERSION)",
+    EMIT_KIND_HINT,
+)
+def _emit_kind_rule(ctx: AuditContext) -> list[Finding]:
+    from attackfl_tpu.telemetry.events import known_kinds
+
+    known = known_kinds()
+    findings: list[Finding] = []
+    for path in ctx.package_sources():
+        findings.extend(
+            emit_kind_findings(path, ctx.tree(path), ctx.root, known))
+    return findings

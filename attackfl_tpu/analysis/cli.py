@@ -1,0 +1,114 @@
+"""``attackfl-tpu audit``: one CLI over every static-analysis pass.
+
+Runs the AST rules (host-sync, donation-after-use, retrace-hazard,
+emit-kind), the event-schema artifact check, and the jaxpr/HLO program
+auditor, then prints a report — human text by default, a machine-readable
+JSON document with ``--json`` (deterministic: no timestamps, repo-relative
+paths — committed once under ``tests/data/audit_report.json`` as the
+golden format corpus).  Exit 0 when the tree is clean, 1 otherwise.
+
+``--retrace`` additionally runs the dynamic retrace guard (executes a few
+CPU rounds per executor — seconds of compile, so opt-in; tier-1 exercises
+the guard through tests/test_analysis.py instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+from attackfl_tpu.analysis.findings import Finding, sort_findings
+from attackfl_tpu.analysis.registry import (
+    AuditContext, describe_rules, run_rules)
+
+REPORT_SCHEMA = 1
+
+
+def build_report(skip_programs: bool = False, retrace: bool = False,
+                 rule_ids: list[str] | None = None) -> dict[str, Any]:
+    """Run the selected passes and assemble the audit report."""
+    ctx = AuditContext()
+    findings: list[Finding] = run_rules(ctx, rule_ids)
+    programs: list[dict[str, Any]] = []
+    budget: dict[str, Any] = {}
+    if not skip_programs:
+        from attackfl_tpu.analysis import program_audit
+
+        reports = program_audit.audit_default_programs()
+        programs = [r.to_dict() for r in reports]
+        findings.extend(program_audit.reports_to_findings(reports))
+        budget = program_audit.transfer_budget()
+    if retrace:
+        from attackfl_tpu.analysis.retrace import guard_findings
+
+        findings.extend(guard_findings())
+    findings = sort_findings(findings)
+    return {
+        "schema": REPORT_SCHEMA,
+        "tool": "attackfl-tpu audit",
+        "rules": describe_rules(),
+        "findings": [f.to_dict() for f in findings],
+        "programs": programs,
+        "transfer_budget": budget,
+        "ok": not findings,
+    }
+
+
+def format_report(report: dict[str, Any]) -> str:
+    lines = []
+    for f in report["findings"]:
+        lines.append(Finding(**f).format())
+    for p in report["programs"]:
+        status = "OK" if p["ok"] else "FAIL"
+        lines.append(
+            f"program {p['name']} [{p['executor']}]: {status} — "
+            f"{p['eqns']} eqns, donated {p['donated_leaves']} leaf(s), "
+            f"aliased {p['aliased_leaves']}/{p['expected_aliases']} "
+            f"expected, forbidden={p['forbidden_primitives'] or 'none'}, "
+            f"f64={p['f64_outputs']}")
+    budget = report.get("transfer_budget") or {}
+    if budget:
+        lines.append(
+            f"transfer budget: {budget['total']} audited host "
+            f"function(s), allowlist "
+            f"{'resolved' if budget['resolved'] else 'STALE'}")
+    n = len(report["findings"])
+    lines.append(
+        f"audit: {len(report['rules'])} rule(s), "
+        f"{len(report['programs'])} program(s), "
+        f"{n} finding(s) — {'OK' if report['ok'] else 'FAIL'}")
+    return "\n".join(lines)
+
+
+def audit_main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="attackfl-tpu audit",
+        description="Static-analysis audit: AST rules + event-schema "
+                    "artifacts + jaxpr/HLO program invariants.")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--skip-programs", action="store_true",
+                        help="AST/artifact rules only (no jax import, no "
+                             "program tracing — fast)")
+    parser.add_argument("--retrace", action="store_true",
+                        help="also run the dynamic retrace guard "
+                             "(EXECUTES a few CPU rounds per executor)")
+    parser.add_argument("--rules", nargs="*", default=None, metavar="RULE",
+                        help="run only these rule ids (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    if args.list_rules:
+        for rule in describe_rules():
+            print(f"{rule['id']}: {rule['description']}")
+        return 0
+    report = build_report(skip_programs=args.skip_programs,
+                          retrace=args.retrace, rule_ids=args.rules)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report))
+    return 0 if report["ok"] else 1

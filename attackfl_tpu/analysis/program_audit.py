@@ -1,0 +1,278 @@
+"""Jaxpr/HLO program auditor: machine-checked invariants of the compiled
+round programs themselves.
+
+The AST rules bound what *host* code may do; this module audits what the
+*programs* actually contain.  For every round program the engine exposes
+through its audit hook (:meth:`Simulator.audit_programs` — sync
+``round_step``/``aggregate`` (or ``hyper_update``), the fused scan chunk,
+and the pipelined single-round step), it abstractly traces the raw
+callable (``jax.make_jaxpr``) and lowers the jitted one
+(``jax.jit(...).lower(...)``) — nothing is executed — and asserts:
+
+* **sync-freedom** — no callback primitive (``pure_callback`` /
+  ``io_callback`` / ``debug_callback`` / ...) and no ``infeed``/``outfeed``
+  anywhere in the program, sub-jaxprs included.  A callback inside a
+  sync-free executor would fence the dispatch queue every round — exactly
+  the class of regression the pipelined executor (BENCH_PIPELINE.json
+  1.24x) cannot absorb.
+* **donation** — for every argument the engine *claims* to donate
+  (:meth:`Simulator.donation_spec`), the aliasing XLA actually established
+  matches expectation: each donated input buffer with a shape/dtype-
+  matching output is aliased (``tf.aliasing_output`` in the lowered
+  StableHLO).  Donated-but-unaliasable buffers (the (C, P) stacked deltas
+  feeding a (P,) aggregation) are *early-free* hints and legitimately
+  alias nothing — the expectation is computed by multiset shape matching,
+  so that case audits as 0 == 0 rather than being waved through.
+* **dtype discipline** — no float64/complex128 value anywhere in the
+  program (an accidental x64 promotion in metrics/aggregation math would
+  double memory traffic and break cross-run comparability).
+* **transfer budget** — the programs contain zero device->host transfer
+  primitives, so every per-round transfer must originate in host code,
+  which the ``host-sync`` rule bounds to its audited allowlist.  The
+  budget (the resolved allowlist) is reported alongside the program
+  results so the two halves are reviewed together.
+
+Run on a CPU-sized representative config (:func:`attackfl_tpu.config.
+audit_config`) — the invariants are properties of program *structure*,
+identical on CPU and TPU.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any
+
+from attackfl_tpu.analysis.findings import Finding
+
+# Primitives that fence or transfer; "callback" as a substring catches the
+# whole jax callback family (pure_callback, io_callback, debug_callback)
+# plus whatever future variant keeps the naming convention.
+FORBIDDEN_PRIMITIVES = frozenset({"infeed", "outfeed"})
+FORBIDDEN_SUBSTRINGS = ("callback",)
+
+FORBIDDEN_HINT = (
+    "host work must live in the engine's audited resolve points (see the "
+    "host-sync rule), never inside a jitted round program")
+DONATION_AUDIT_HINT = (
+    "the donation declared in Simulator.donation_spec() did not produce "
+    "the expected input-output aliasing — check that the donated argument "
+    "is the program's last consumer and shapes still line up")
+F64_HINT = (
+    "keep round math in f32/bf16: find the promotion (np.float64 scalar, "
+    "Python float in a jnp op under x64) and cast it explicitly")
+
+
+def _iter_subjaxprs(value: Any):
+    """Yield every Jaxpr reachable from an eqn param value (ClosedJaxpr,
+    Jaxpr, or lists of either)."""
+    values = value if isinstance(value, (list, tuple)) else [value]
+    for v in values:
+        if hasattr(v, "eqns"):          # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr"):       # ClosedJaxpr
+            yield v.jaxpr
+
+
+def walk_jaxpr(jaxpr) -> Counter:
+    """Primitive-name counts over a jaxpr and all sub-jaxprs (scan/cond/
+    while bodies, inner pjit calls, custom-derivative rules)."""
+    counts: Counter = Counter()
+    stack = [jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                stack.extend(_iter_subjaxprs(v))
+    return counts
+
+
+def forbidden_primitives(counts: Counter) -> list[str]:
+    bad = []
+    for name in counts:
+        if name in FORBIDDEN_PRIMITIVES or any(
+                s in name for s in FORBIDDEN_SUBSTRINGS):
+            bad.append(name)
+    return sorted(bad)
+
+
+def wide_dtype_outputs(jaxpr) -> int:
+    """Count of equation outputs with a 64-bit float/complex dtype
+    anywhere in the program (0 on a dtype-disciplined program)."""
+    import numpy as np
+
+    wide = (np.dtype("float64"), np.dtype("complex128"))
+    n = 0
+    stack = [jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                dtype = getattr(aval, "dtype", None)
+                if dtype is not None and dtype in wide:
+                    n += 1
+            for v in eqn.params.values():
+                stack.extend(_iter_subjaxprs(v))
+    return n
+
+
+def _aval_key(x) -> tuple:
+    return (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "?")))
+
+
+def expected_alias_count(donated_leaves, output_leaves) -> int:
+    """How many donated input buffers SHOULD alias an output: greedy
+    multiset matching on (shape, dtype) — the same criterion jax uses when
+    deciding which donated buffers are usable."""
+    available = Counter(_aval_key(o) for o in output_leaves)
+    n = 0
+    for leaf in donated_leaves:
+        key = _aval_key(leaf)
+        if available[key] > 0:
+            available[key] -= 1
+            n += 1
+    return n
+
+
+@dataclass
+class ProgramReport:
+    """Audit result for one round program (JSON-ready via ``to_dict``)."""
+
+    name: str
+    executor: str
+    eqns: int
+    distinct_primitives: int
+    forbidden: list[str]
+    donated_args: tuple[int, ...]
+    donated_leaves: int
+    expected_aliases: int
+    aliased_leaves: int
+    f64_outputs: int
+    problems: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "executor": self.executor, "ok": self.ok,
+            "eqns": self.eqns,
+            "distinct_primitives": self.distinct_primitives,
+            "forbidden_primitives": self.forbidden,
+            "donated_args": list(self.donated_args),
+            "donated_leaves": self.donated_leaves,
+            "expected_aliases": self.expected_aliases,
+            "aliased_leaves": self.aliased_leaves,
+            "f64_outputs": self.f64_outputs,
+            "problems": self.problems,
+        }
+
+
+def audit_program(name: str, executor: str, raw, jit_fn, args: tuple,
+                  donate: tuple[int, ...]) -> ProgramReport:
+    """Audit one program: jaxpr invariants from ``raw``, donation aliasing
+    from lowering ``jit_fn``.  Pure analysis — nothing executes."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(raw)(*args)
+    counts = walk_jaxpr(jaxpr)
+    forbidden = forbidden_primitives(counts)
+    f64 = wide_dtype_outputs(jaxpr)
+
+    donated_leaves = [leaf for i in donate
+                      for leaf in jax.tree.leaves(args[i])]
+    outputs = jax.tree.leaves(jax.eval_shape(raw, *args))
+    expected = expected_alias_count(donated_leaves, outputs)
+    # the lowered StableHLO carries one tf.aliasing_output attribute per
+    # input buffer jax actually donated AND found an aliasable output for
+    aliased = jit_fn.lower(*args).as_text().count("tf.aliasing_output")
+
+    report = ProgramReport(
+        name=name, executor=executor,
+        eqns=sum(counts.values()), distinct_primitives=len(counts),
+        forbidden=forbidden, donated_args=tuple(donate),
+        donated_leaves=len(donated_leaves), expected_aliases=expected,
+        aliased_leaves=aliased, f64_outputs=f64,
+    )
+    if forbidden:
+        report.problems.append(
+            f"forbidden host-transfer primitive(s) in a sync-free program: "
+            f"{', '.join(forbidden)}")
+    if aliased != expected:
+        report.problems.append(
+            f"donation aliasing mismatch: {aliased} aliased buffer(s) in "
+            f"the lowered program, expected {expected} (donated leaves: "
+            f"{len(donated_leaves)})")
+    if f64 > 0:
+        report.problems.append(
+            f"{f64} float64/complex128 value(s) in the program — "
+            "unexpected wide-dtype promotion")
+    return report
+
+
+def audit_simulator(sim) -> list[ProgramReport]:
+    """Audit every program the Simulator's audit hook exposes."""
+    return [
+        audit_program(p["name"], p["executor"], p["raw"], p["jit"],
+                      p["args"], p["donate"])
+        for p in sim.audit_programs()
+    ]
+
+
+def audit_default_programs(modes: tuple[str, ...] = ("fedavg",)
+                           ) -> list[ProgramReport]:
+    """Build the representative CPU-sized Simulator(s) and audit their
+    programs.  ``modes`` extends coverage (e.g. ``("fedavg", "hyper")``)
+    at ~seconds of tracing per mode."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    reports: list[ProgramReport] = []
+    for mode in modes:
+        cfg = audit_config(mode=mode)
+        sim = Simulator(cfg)
+        try:
+            for report in audit_simulator(sim):
+                report.name = f"{mode}:{report.name}"
+                reports.append(report)
+        finally:
+            sim.close()
+    return reports
+
+
+def reports_to_findings(reports: list[ProgramReport]) -> list[Finding]:
+    """Program-level problems as findings (rule ``program-audit``; the
+    'file' is the program name — there is no single source line)."""
+    findings = []
+    for report in reports:
+        for problem in report.problems:
+            hint = FORBIDDEN_HINT
+            if "aliasing" in problem:
+                hint = DONATION_AUDIT_HINT
+            elif "float64" in problem:
+                hint = F64_HINT
+            findings.append(Finding(
+                rule="program-audit", file=f"<program:{report.name}>",
+                line=0, message=problem, hint=hint))
+    return findings
+
+
+def transfer_budget() -> dict[str, Any]:
+    """The audited device->host transfer budget: since the programs carry
+    zero transfer primitives (checked above), every per-round transfer
+    originates in an allowlisted host function.  Returns the resolved
+    allowlist as the budget, with per-file entries."""
+    from attackfl_tpu.analysis.ast_rules import (
+        ALLOWED_FUNCTIONS, resolve_host_sync_allowlist)
+
+    drift = resolve_host_sync_allowlist()
+    return {
+        "audited_functions": {name: sorted(quals)
+                              for name, quals in sorted(
+                                  ALLOWED_FUNCTIONS.items())},
+        "total": sum(len(q) for q in ALLOWED_FUNCTIONS.values()),
+        "resolved": not drift,
+    }

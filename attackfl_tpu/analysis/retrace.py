@@ -1,0 +1,126 @@
+"""Retrace guard: the dynamic half of the graph auditor.
+
+A jitted round program must compile on round 1 and never again — a silent
+retrace (shape drift, a fresh Python scalar in the signature, a rebuilt
+closure) re-pays multi-second compiles every round and is invisible in
+wall-clock noise until it dominates.  The static ``retrace-hazard`` rule
+catches the *patterns*; this harness catches the *fact*: it snapshots the
+per-callable jit-cache sizes after the first round and fails if any cache
+grows over the rest of a multi-round run.
+
+``_cache_size()`` is jax's per-PjitFunction compiled-signature count: one
+entry per distinct (structure, shape, dtype) signature.  A new jitted
+callable appearing after the snapshot (e.g. the fused path's length-1
+retry-tail program) is a new *program* — allowed one entry; an existing
+callable growing beyond its snapshot is a retrace — failed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from attackfl_tpu.analysis.findings import Finding
+
+RETRACE_GUARD_HINT = (
+    "find what changed in the call signature after round 1 (shape, dtype, "
+    "weak type, container structure) and make it round-invariant — the "
+    "static retrace-hazard rule lists the usual sources")
+
+
+def jitted_programs(sim) -> dict[str, Any]:
+    """Every jitted callable a Simulator owns, by a stable name: direct
+    attributes, the fused/pipeline program caches, and the validation
+    evaluators."""
+    programs: dict[str, Any] = {}
+    for name, value in vars(sim).items():
+        if hasattr(value, "_cache_size"):
+            programs[name] = value
+    for length, fn in getattr(sim, "_fused_cache", {}).items():
+        if hasattr(fn, "_cache_size"):
+            programs[f"_fused_cache[{length}]"] = fn
+    for key, fn in getattr(sim, "_pipeline_cache", {}).items():
+        if hasattr(fn, "_cache_size"):
+            programs[f"_pipeline_cache[{key}]"] = fn
+    validation = getattr(sim, "validation", None)
+    if validation is not None:
+        for name, value in vars(validation).items():
+            if hasattr(value, "_cache_size"):
+                programs[f"validation.{name}"] = value
+    return programs
+
+
+class RetraceGuard:
+    """Snapshot-then-check trace counter over one Simulator's programs."""
+
+    def __init__(self, sim):
+        self.sim = sim
+        self.baseline: dict[str, int] | None = None
+
+    def snapshot(self) -> dict[str, int]:
+        """Record the current per-program trace counts (call after the
+        first round, i.e. after every program has compiled once)."""
+        self.baseline = {name: fn._cache_size()
+                         for name, fn in jitted_programs(self.sim).items()}
+        return dict(self.baseline)
+
+    def violations(self) -> list[str]:
+        """Programs that retraced since :meth:`snapshot`."""
+        if self.baseline is None:
+            raise RuntimeError("snapshot() the guard before checking it")
+        problems = []
+        for name, fn in jitted_programs(self.sim).items():
+            size = fn._cache_size()
+            before = self.baseline.get(name)
+            if before is None:
+                if size > 1:  # new program: one compile is legitimate
+                    problems.append(
+                        f"{name}: new jitted callable already holds {size} "
+                        "traced signatures")
+            elif size > before:
+                problems.append(
+                    f"{name}: retraced after round 1 "
+                    f"({before} -> {size} signatures)")
+        return problems
+
+
+def run_with_guard(sim, num_rounds: int = 3, pipeline: bool = False,
+                   runner: Callable | None = None) -> list[str]:
+    """Run one round, snapshot, run the remaining rounds, return retrace
+    violations.  ``runner(sim, state, target_rounds)`` overrides the
+    default ``sim.run`` loop (run_fast chunks, custom drivers)."""
+    if runner is None:
+        def runner(sim, state, target):
+            state, _ = sim.run(num_rounds=target, state=state,
+                               save_checkpoints=False, verbose=False,
+                               pipeline=pipeline)
+            return state
+
+    state = runner(sim, None, 1)
+    guard = RetraceGuard(sim)
+    guard.snapshot()
+    runner(sim, state, num_rounds)
+    return guard.violations()
+
+
+def guard_findings(modes_and_executors=(("fedavg", False),
+                                        ("fedavg", True))) -> list[Finding]:
+    """CLI entry (``audit --retrace``): run the guard over the
+    representative config on the sync and pipelined executors (the fused
+    executor shares the pipelined body).  EXECUTES rounds — seconds of
+    compile + train on CPU, unlike the purely static passes."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    findings = []
+    for mode, pipeline in modes_and_executors:
+        sim = Simulator(audit_config(mode=mode))
+        try:
+            for problem in run_with_guard(sim, num_rounds=3,
+                                          pipeline=pipeline):
+                findings.append(Finding(
+                    rule="retrace-guard",
+                    file=f"<run:{mode}:{'pipelined' if pipeline else 'sync'}>",
+                    line=0, message=problem, hint=RETRACE_GUARD_HINT))
+        finally:
+            sim.close()
+    return findings

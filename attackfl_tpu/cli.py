@@ -351,12 +351,24 @@ def watch_main(argv=None) -> int:
         time.sleep(args.interval)
 
 
+def audit_main(argv=None) -> int:
+    """``attackfl-tpu audit``: the static-analysis subsystem — AST rules
+    (host-sync, donation-after-use, retrace-hazard, emit-kind), committed
+    event-artifact schema validation, and the jaxpr/HLO program auditor
+    (sync-freedom, donation aliasing, dtype discipline) over the three
+    round executors.  ``--json`` for the machine-readable report."""
+    from attackfl_tpu.analysis.cli import audit_main as _audit_main
+
+    return _audit_main(list(sys.argv[1:] if argv is None else argv))
+
+
 _SUBCOMMANDS = {
     "run": run_main,
     "server": server_main,
     "client": client_main,
     "metrics": metrics_main,
     "watch": watch_main,
+    "audit": audit_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -369,6 +381,8 @@ commands:
            --merge: cross-host skew; --forensics: defense TPR/FPR;
            --numerics: in-graph device-side round metrics)
   watch    poll a live run's monitor endpoint (/last-round, /healthz)
+  audit    static analysis: AST rules + event-schema artifacts + jaxpr/HLO
+           program invariants (--json for the machine-readable report)
 """
 
 

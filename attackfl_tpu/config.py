@@ -427,6 +427,35 @@ class Config:
         return dataclasses.replace(self, **kw)
 
 
+def audit_config(**overrides: Any) -> Config:
+    """Representative CPU-sized config for the static program auditor
+    (attackfl_tpu/analysis/program_audit) and the retrace guard.
+
+    Small enough to trace/lower in seconds on one CPU device, yet it
+    exercises the full round program: an active LIE attacker group (attack
+    + cohort-mask ops in-graph), validation (the eval program folds into
+    the fused/pipelined bodies) and the default fedavg aggregation.
+    Telemetry is disabled — auditing must not write event files or spin up
+    monitors — and logs/checkpoints go to a throwaway temp dir so running
+    ``attackfl-tpu audit`` never litters the working tree.  Keyword
+    overrides replace any field (e.g. ``mode="hyper"`` to audit the
+    hypernetwork programs).
+    """
+    import tempfile
+
+    scratch = tempfile.mkdtemp(prefix="attackfl_audit_")
+    base: dict[str, Any] = dict(
+        num_round=3, total_clients=4, mode="fedavg", model="CNNModel",
+        data_name="ICU", num_data_range=(48, 64), epochs=1, batch_size=32,
+        train_size=256, test_size=128,
+        attacks=(AttackSpec(mode="LIE", num_clients=1, attack_round=2),),
+        telemetry=TelemetryConfig(enabled=False),
+        log_path=scratch, checkpoint_dir=scratch,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
 def _get(d: dict, key: str, default: Any) -> Any:
     return d.get(key, default) if isinstance(d, dict) else default
 

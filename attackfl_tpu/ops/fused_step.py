@@ -71,6 +71,15 @@ _GELU_C = math.sqrt(2.0 / math.pi)
 S_BD, S_BV, S_BO, S_B1F, S_B2F, S_G1, S_BE1, S_G2, S_BE2, S_G3, S_BE3 = range(11)
 S_BF1, S_BF2, S_WOUT, S_BOUT = 22, 23, 24, 25
 
+# Explicit donation contract of the eager per-epoch dispatch (packed
+# params / Adam m / Adam v donated in place across epochs — the per-client
+# optimizer state never holds two HBM copies).  A module constant so the
+# static donation analyzer (attackfl_tpu/analysis) and readers see the
+# policy without digging through the jit call; the donated groups are
+# rebound from the call's results in the same statement, which is exactly
+# the pattern the donation-after-use rule requires.
+EPOCH_DONATE_ARGNUMS = (0, 1, 2)
+
 BRANCHES = ("vitals", "labs")
 IN_DIMS = (7, 16)
 IN_OFFS = (0, 7)   # column offsets of each branch's features in the batch
@@ -592,7 +601,7 @@ def build_fused_local_update(dataset, *, epochs, batch_size, lr,
                     clip=clip_grad_norm if clip_grad_norm else 0.0,
                     drop_attn=dropout[0], drop_block=dropout[1],
                     drop_head=dropout[2], g_clients=G, interpret=False),
-                donate_argnums=(0, 1, 2))
+                donate_argnums=EPOCH_DONATE_ARGNUMS)
 
         # same per-client key schedule as the JAX path (local.py):
         # per client: epoch keys = split(rng, E); per epoch (k_perm, k_drop)

@@ -88,6 +88,31 @@ _OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
     "round": int, "broadcast": int, "numerics": dict, "hist": list,
 }
 
+# Which schema version introduced each kind.  The static-analysis
+# ``emit-kind`` rule (attackfl_tpu/analysis/ast_rules.py) checks every
+# ``.emit("<kind>")`` literal against :func:`known_kinds` for the version
+# it targets, and the consistency of this table with REQUIRED_FIELDS is
+# itself asserted (tests/test_telemetry.py) — a new kind must land in
+# both, with a version bump.
+KINDS_BY_VERSION: dict[int, frozenset[str]] = {
+    1: frozenset({"run_header", "round", "chunk", "compile", "retry",
+                  "rollback", "checkpoint", "validation", "counters",
+                  "run_end", "metric"}),
+    2: frozenset({"stall", "attribution", "profile"}),
+    3: frozenset(),  # v3 only adds optional fields on `metric`
+}
+
+
+def known_kinds(version: int = SCHEMA_VERSION) -> frozenset[str]:
+    """Every event kind valid at ``version`` (kinds are only ever added,
+    so this is the union over versions <= ``version``)."""
+    if version not in KINDS_BY_VERSION:
+        raise ValueError(
+            f"unknown schema version {version}; have "
+            f"{sorted(KINDS_BY_VERSION)}")
+    return frozenset().union(
+        *(kinds for v, kinds in KINDS_BY_VERSION.items() if v <= version))
+
 _COMMON_FIELDS: dict[str, Any] = {"schema": int, "kind": str, "ts": _NUM}
 # Envelope fields that MAY appear (schema v2) and are type-checked when
 # present; absent is always valid (v1 files carry neither).

@@ -227,6 +227,10 @@ class Simulator:
 
         # ---- mode-specific programs ------------------------------------
         self.is_hyper = cfg.mode == "hyper"
+        # donation policy resolved ONCE (donation_spec) so the jit calls
+        # below, the audit hook (audit_programs) and the static analyzers
+        # (attackfl_tpu/analysis) all read the same source of truth
+        donation = self.donation_spec()
         if self.is_hyper:
             init_rng = jax.random.key(cfg.random_seed, impl=cfg.prng_impl)
             template = self.model.init(init_rng, *sample_inputs(cfg.data_name))["params"]
@@ -258,8 +262,7 @@ class Simulator:
             # keep full donation because their numerics live inside the
             # same program.
             self.hyper_update = jax.jit(
-                hyper_update,
-                donate_argnums=() if self._numerics_on else (2,))
+                hyper_update, donate_argnums=donation["hyper_update"])
             self._hyper_update_raw = hyper_update
             self.detector = None
             if cfg.hyper_detection.enable:
@@ -289,8 +292,7 @@ class Simulator:
             # bit-identical either way; fused/pipelined paths keep
             # donation since their numerics are inside the same program).
             self.aggregate = jax.jit(
-                aggregate,
-                donate_argnums=() if self._numerics_on else (1,))
+                aggregate, donate_argnums=donation["aggregate"])
             self._aggregate_raw = aggregate
 
         # ---- defense forensics ------------------------------------------
@@ -377,6 +379,102 @@ class Simulator:
             self._ckpt_writer = ckpt.AsyncCheckpointWriter(
                 on_write=lambda _path: self.telemetry.counters.inc(
                     "checkpoint_writes"))
+
+    # ------------------------------------------------------------------
+    # audit hooks (attackfl_tpu/analysis — ISSUE 5)
+    # ------------------------------------------------------------------
+
+    def donation_spec(self) -> dict[str, tuple[int, ...]]:
+        """The engine's buffer-donation policy, stated in ONE place.
+
+        Keys are round-program names, values the ``donate_argnums`` their
+        ``jax.jit`` calls are built with (``__init__`` / ``_fused_chunk``
+        / ``_pipeline_step_fn`` all read this, so the declared policy and
+        the compiled programs cannot drift).  The jaxpr/HLO auditor
+        (:mod:`attackfl_tpu.analysis.program_audit`) lowers each program
+        and checks the declared donation against the aliasing XLA actually
+        established.  Synchronous-path donation of the stacked client tree
+        is OFF when in-graph numerics is enabled — the numerics step is
+        dispatched after aggregation and still reads ``stacked``
+        (see the jit call sites for the full rationale)."""
+        spec: dict[str, tuple[int, ...]] = {"round_step": ()}
+        if self.is_hyper:
+            spec["generate_all"] = ()
+            spec["hyper_update"] = () if self._numerics_on else (2,)
+        else:
+            spec["aggregate"] = () if self._numerics_on else (1,)
+        spec["fused_chunk"] = (0,)
+        # applied only when checkpointing is off (the caller keeps no
+        # reference to the pre-round state) — see _run_pipelined
+        spec["pipeline_step"] = (0,)
+        return spec
+
+    def audit_programs(self, state: dict[str, Any] | None = None
+                       ) -> list[dict[str, Any]]:
+        """Every jitted round program with concrete example arguments, for
+        the static program auditor: ``{name, executor, raw, jit, args,
+        donate}`` per program.  ``raw`` is the traceable Python callable
+        (``jax.make_jaxpr``-ready), ``jit`` its jitted counterpart
+        (``.lower()``-ready), ``donate`` the donation policy from
+        :meth:`donation_spec`.  Nothing is executed — large operands are
+        ``ShapeDtypeStruct``s where possible."""
+        state = self._canonical_device_state(self._ensure_numerics_state(
+            state if state is not None else self.init_state()))
+        spec = self.donation_spec()
+        _, k_round, k_agg = jax.random.split(state["rng"], 3)
+        b = jnp.asarray(1)
+        programs: list[dict[str, Any]] = []
+        if self.is_hyper:
+            args = (state["hnet_params"], state["prev_genuine"],
+                    state["have_genuine"], jnp.asarray(state["active_mask"]),
+                    k_round, b)
+            stacked, sizes, *_ = jax.eval_shape(self._round_step_raw, *args)
+            programs.append(dict(
+                name="round_step", executor="sync",
+                raw=self._round_step_raw, jit=self.round_step, args=args,
+                donate=spec["round_step"]))
+            programs.append(dict(
+                name="hyper_update", executor="sync",
+                raw=self._hyper_update_raw, jit=self.hyper_update,
+                args=(state["hnet_params"], state["hyper_opt_state"],
+                      stacked, jnp.asarray(state["active_mask"])),
+                donate=spec["hyper_update"]))
+        else:
+            args = (state["global_params"], state["prev_genuine"],
+                    state["have_genuine"], k_round, b)
+            stacked, sizes, *_ = jax.eval_shape(self._round_step_raw, *args)
+            wmask = jnp.ones((self.cfg.total_clients,), jnp.float32)
+            programs.append(dict(
+                name="round_step", executor="sync",
+                raw=self._round_step_raw, jit=self.round_step, args=args,
+                donate=spec["round_step"]))
+            programs.append(dict(
+                name="aggregate", executor="sync",
+                raw=self._aggregate_raw, jit=self.aggregate,
+                args=(state["global_params"], stacked, sizes, wmask, k_agg),
+                donate=spec["aggregate"]))
+        if self.supports_fused():
+            body = self._build_fused_body()
+
+            def chunk2(s):
+                return jax.lax.scan(body, s, None, length=2)
+
+            programs.append(dict(
+                name="fused_chunk[2]", executor="fused",
+                raw=chunk2, jit=self._fused_chunk(2), args=(state,),
+                donate=spec["fused_chunk"]))
+            include_eval = self.validation is not None
+            body_pipeline = self._build_fused_body(include_eval=include_eval)
+
+            def step(s):
+                return body_pipeline(s, None)
+
+            programs.append(dict(
+                name=f"pipeline_step[eval={include_eval}]",
+                executor="pipelined", raw=step,
+                jit=self._pipeline_step_fn(include_eval, donate=True),
+                args=(state,), donate=spec["pipeline_step"]))
+        return programs
 
     # ------------------------------------------------------------------
     # state
@@ -1269,7 +1367,8 @@ class Simulator:
             def chunk(state):
                 return jax.lax.scan(body, state, None, length=length)
 
-            fn = jax.jit(chunk, donate_argnums=0)
+            fn = jax.jit(chunk,
+                         donate_argnums=self.donation_spec()["fused_chunk"])
             self._fused_cache[length] = fn
         else:
             self.telemetry.counters.inc("round_program_cache_hits")
@@ -1510,7 +1609,10 @@ class Simulator:
             def step(state):
                 return body(state, None)
 
-            fn = jax.jit(step, donate_argnums=(0,) if donate else ())
+            fn = jax.jit(
+                step,
+                donate_argnums=(self.donation_spec()["pipeline_step"]
+                                if donate else ()))
             self._pipeline_cache[key] = fn
         return fn
 

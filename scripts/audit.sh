@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-shot static-analysis gate (ISSUE 5 satellite): the full audit — AST
+# rules (host-sync, donation-after-use, retrace-hazard, emit-kind),
+# committed event-artifact schema validation, and the jaxpr/HLO program
+# auditor over the sync/fused/pipelined executors — plus the two legacy
+# lint entry points (now shims over attackfl_tpu/analysis, kept here so
+# this script fails if the shims rot).  Used by tier-1 through
+# tests/test_audit.py; run it directly before sending a PR.
+#
+# Usage: scripts/audit.sh [extra `attackfl-tpu audit` args, e.g. --json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+# program tracing needs a backend; default to CPU unless the caller pinned
+# one (the invariants are structural — identical on CPU and TPU)
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python -m attackfl_tpu audit "$@"
+python scripts/check_event_schema.py
+python scripts/check_host_sync.py

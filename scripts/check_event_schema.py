@@ -1,19 +1,11 @@
-"""Lint telemetry artifacts: validate every ``events.jsonl`` under the
-given paths (default: the repo root, i.e. committed bench artifacts)
-against the telemetry event schema
-(``attackfl_tpu.telemetry.events.REQUIRED_FIELDS``).
+"""Validate telemetry event artifacts against the schema — THIN SHIM.
 
-Schema v2 aware: per-process multi-host files (``events.<i>.jsonl``) are
-globbed too, and the v2 kinds (``stall``, ``attribution``, ``profile``)
-plus the ``process_index`` envelope field validate through the same
-``validate_event`` the writers use.  Schema v3 (ISSUE 4) extends
-``metric`` events with optional in-graph numerics payloads
-(``round``/``broadcast``/``numerics``/``hist``), type-checked when
-present.  v1/v2 artifacts stay green — each version only adds kinds and
-optional fields.  ``tests/test_event_artifacts.py`` runs this over the
-repo's committed artifacts (including the v3 corpus
-``tests/data/events.v3.jsonl``) in tier-1 so schema drift fails CI
-instead of rotting silently.
+The lint body moved into the static-analysis subsystem (ISSUE 5):
+``attackfl_tpu/analysis/artifacts.py`` owns the event-file globbing and
+per-line validation (through the same ``validate_event`` the writers
+use), surfaced as the ``event-schema`` rule of ``attackfl-tpu audit``.
+This script path is kept so existing invocations and
+tests/test_event_artifacts.py keep working unchanged.
 
 Usage: python scripts/check_event_schema.py [path ...]
 Exit 0 when every line of every found file validates; 1 otherwise.
@@ -24,59 +16,19 @@ directly.
 
 from __future__ import annotations
 
-import json
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from attackfl_tpu.telemetry.events import validate_event  # noqa: E402
+from attackfl_tpu.analysis.artifacts import (  # noqa: E402
+    event_schema_check_file as check_file,
+    event_schema_main as main,
+    find_event_files,
+)
 
-
-def find_event_files(path: Path) -> list[Path]:
-    if path.is_file():
-        return [path]
-    return sorted(set(path.rglob("events.jsonl")) |
-                  set(path.rglob("events.*.jsonl")) |
-                  set(path.rglob("*.events.jsonl")))
-
-
-def check_file(path: Path) -> list[str]:
-    errors: list[str] = []
-    with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                record = json.loads(line)
-            except json.JSONDecodeError as e:
-                errors.append(f"{path}:{lineno}: not JSON ({e})")
-                continue
-            for problem in validate_event(record):
-                errors.append(f"{path}:{lineno}: {problem}")
-    return errors
-
-
-def main(argv: list[str] | None = None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
-    roots = [Path(a) for a in args] or [REPO]
-    files: list[Path] = []
-    for root in roots:
-        if not root.exists():
-            print(f"error: no such path {root}", file=sys.stderr)
-            return 1
-        files.extend(find_event_files(root))
-    errors: list[str] = []
-    for path in files:
-        errors.extend(check_file(path))
-    for problem in errors:
-        print(problem)
-    print(f"checked {len(files)} file(s): "
-          f"{'OK' if not errors else f'{len(errors)} schema violation(s)'}")
-    return 1 if errors else 0
-
+__all__ = ["check_file", "find_event_files", "main"]
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -1,168 +1,39 @@
-"""Lint the training hot path for host-device sync barriers.
+"""Lint the training hot path for host-device sync barriers — THIN SHIM.
 
-The pipelined executor (ISSUE 3) exists because every host
-materialization of a device value — ``jax.block_until_ready``,
-``float(...)`` / ``np.asarray(...)`` on an in-flight array,
-``jax.device_get`` — fences the dispatch queue and serializes device
-compute behind Python.  This lint walks the AST of every module under
-``attackfl_tpu/training/`` — plus the numerics-engine files
-``ops/metrics.py`` (device-side metric fns, which by contract are
-traced-only: a ``float(...)`` inside one would fence every jitted round)
-and ``telemetry/numerics.py`` (whose drainer owns the subsystem's ONE
-audited device-to-host transfer) — and flags those calls anywhere OUTSIDE
-the audited allowlist below, so a new sync can't silently creep back onto
-the critical path.  It cannot see types, so the allowlist is
-function-granular: a listed function is an audited location where
-materialization is intentional (resolve points, host-side defenses,
-failure diagnostics) or provably host-only (init-time constants).
-
-Wired into tier-1 via tests/test_host_sync_lint.py, like
-``check_event_schema.py``.
+The lint body moved into the static-analysis subsystem (ISSUE 5):
+``attackfl_tpu/analysis/ast_rules.py`` owns the sync-call detection, the
+audited allowlist (now resolved against the live modules, so a renamed
+audited function fails the lint instead of leaving a dead entry), and the
+``host-sync`` rule the ``attackfl-tpu audit`` CLI runs.  This script path
+is kept so existing invocations and tests/test_host_sync_lint.py keep
+working unchanged.
 
 Usage: python scripts/check_host_sync.py [file ...]
 Exit 0 when no unaudited sync call exists; 1 otherwise (each violation is
 printed as ``file:line: call in function``).  Adding a genuinely needed
 sync means either moving it into an audited resolve function or extending
-ALLOWED_FUNCTIONS with a comment saying why it must block.
+ALLOWED_FUNCTIONS (in analysis/ast_rules.py) with a comment saying why it
+must block.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-TRAINING = REPO / "attackfl_tpu" / "training"
-# the numerics engine (ISSUE 4) is held to the same standard: metric
-# compute fns are traced-only, and exactly one drain transfer is audited
-NUMERICS_FILES = (
-    REPO / "attackfl_tpu" / "ops" / "metrics.py",
-    REPO / "attackfl_tpu" / "telemetry" / "numerics.py",
+sys.path.insert(0, str(REPO))
+
+from attackfl_tpu.analysis.ast_rules import (  # noqa: E402
+    ALLOWED_FUNCTIONS,
+    NUMERICS_FILES,
+    TRAINING,
+    host_sync_check_file as check_file,
+    host_sync_main as main,
 )
 
-# Call shapes that materialize device values on host.
-SYNC_ATTRS = {"block_until_ready", "device_get"}
-SYNC_NAMES = {"float"}
-SYNC_NP_ATTRS = {"asarray", "array"}
-NP_MODULES = {"np", "numpy"}
-
-# file -> audited functions (qualified as Class.method for methods).
-# Every entry is a deliberate materialization point:
-#   - _run_plain_round / _run_hyper_round: the synchronous path's round
-#     gate (train ok flag, host-side gmm/fltracer defenses, loss print)
-#   - _emit_attribution: forensics read the defense verdict per round
-#   - _resolve_pipeline_round / _resolve_inflight_validations: the
-#     pipelined path's designated one-round-late resolve points
-#   - run_fast: per-chunk materialization of the fused scan's metrics
-#   - _save_checkpoint (via checkpoint.host_state): the device->host
-#     gather deliberately stays on the round loop (ISSUE 3 tentpole)
-#   - _init_host_state / __init__: np.asarray on host-Python constants
-#     and raw dataset numpy (not device values) while building templates
-#   - run_scan: one pre-dispatch guard materializing a resumed state's
-#     active_mask (once per scan call, not per round)
-#   - round.py build_round_step: float() on a host model attribute at
-#     program-build time
-ALLOWED_FUNCTIONS: dict[str, set[str]] = {
-    "engine.py": {
-        "Simulator.__init__",
-        "Simulator._run_plain_round",
-        "Simulator._run_hyper_round",
-        "Simulator._emit_attribution",
-        "Simulator._resolve_pipeline_round",
-        "Simulator._resolve_inflight_validations",
-        "Simulator.run_fast",
-        "Simulator.run_scan",
-        "Simulator._init_host_state",
-    },
-    "round.py": {
-        "build_round_step",
-    },
-    # telemetry/numerics.py: NumericsDrainer.drain is the numerics
-    # subsystem's SINGLE audited device->host transfer — one np.asarray of
-    # the whole ring buffer, amortized over up to `window` rounds, called
-    # off the dispatch edge (sync path) or at run end.  Everything else in
-    # that file (including _emit_row) handles already-host numpy via
-    # .item() and stays lint-clean; ops/metrics.py is traced-only and has
-    # NO allowlisted functions by design.
-    "numerics.py": {
-        "NumericsDrainer.drain",
-    },
-}
-
-
-def _qualname(stack: list[str]) -> str:
-    return ".".join(stack) if stack else "<module>"
-
-
-def _sync_call_name(node: ast.Call) -> str | None:
-    func = node.func
-    if isinstance(func, ast.Name) and func.id in SYNC_NAMES:
-        return func.id
-    if isinstance(func, ast.Attribute):
-        if func.attr in SYNC_ATTRS:
-            return func.attr
-        if (func.attr in SYNC_NP_ATTRS and isinstance(func.value, ast.Name)
-                and func.value.id in NP_MODULES):
-            return f"{func.value.id}.{func.attr}"
-    return None
-
-
-class SyncFinder(ast.NodeVisitor):
-    def __init__(self, filename: str, allowed: set[str]):
-        self.filename = filename
-        self.allowed = allowed
-        self.stack: list[str] = []
-        self.violations: list[str] = []
-
-    def _visit_scope(self, node) -> None:
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_FunctionDef = _visit_scope
-    visit_AsyncFunctionDef = _visit_scope
-    visit_ClassDef = _visit_scope
-
-    def visit_Call(self, node: ast.Call) -> None:
-        name = _sync_call_name(node)
-        if name is not None:
-            # qualify against the nearest class.method / function pair so
-            # nested closures inherit their enclosing function's audit
-            qual = _qualname(self.stack[:2])
-            if qual not in self.allowed:
-                self.violations.append(
-                    f"{self.filename}:{node.lineno}: host sync `{name}` in "
-                    f"{qual} — materializes a device value on the round "
-                    "hot path (see scripts/check_host_sync.py)")
-        self.generic_visit(node)
-
-
-def check_file(path: Path) -> list[str]:
-    rel = path.name
-    allowed = ALLOWED_FUNCTIONS.get(rel, set())
-    tree = ast.parse(path.read_text(), filename=str(path))
-    finder = SyncFinder(str(path), allowed)
-    finder.visit(tree)
-    return finder.violations
-
-
-def main(argv: list[str] | None = None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
-    files = ([Path(a) for a in args] if args
-             else sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES))
-    violations: list[str] = []
-    for path in files:
-        if not path.exists():
-            print(f"error: no such file {path}", file=sys.stderr)
-            return 1
-        violations.extend(check_file(path))
-    for line in violations:
-        print(line)
-    print(f"checked {len(files)} file(s): "
-          f"{'OK' if not violations else f'{len(violations)} host sync(s)'}")
-    return 1 if violations else 0
-
+__all__ = ["ALLOWED_FUNCTIONS", "NUMERICS_FILES", "TRAINING",
+           "check_file", "main"]
 
 if __name__ == "__main__":
     raise SystemExit(main())

@@ -1,0 +1,25 @@
+"""Seeded violation for the ``donation-after-use`` rule.
+
+tests/test_analysis.py asserts the exact rule id + line numbers below —
+append to this file, never insert lines.  NOT collected by pytest and NOT
+part of the package (the audit scans ``attackfl_tpu/`` only).
+"""
+import jax
+
+
+def bad_aggregate(params, stacked):
+    agg = jax.jit(lambda p, s: p, donate_argnums=(1,))
+    out = agg(params, stacked)
+    leak = stacked.sum()  # line 13: read after donation — the violation
+    return out, leak
+
+
+def clean_rebind(params, stacked):
+    step = jax.jit(lambda p, s: (p, s * 0), donate_argnums=(1,))
+    params, stacked = step(params, stacked)
+    return stacked.sum()  # rebound from the call's result: clean
+
+
+def direct_form(x, y):
+    out = jax.jit(lambda a, b: a + b, donate_argnums=(0,))(x, y)
+    return out + x  # line 25: read after direct-form donation

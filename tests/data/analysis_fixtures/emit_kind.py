@@ -1,0 +1,11 @@
+"""Seeded violations for the ``emit-kind`` rule.
+
+tests/test_analysis.py asserts the exact rule id + line numbers below —
+append to this file, never insert lines.
+"""
+
+
+def record(log):
+    log.emit("round", ok=True)  # known kind: clean
+    log.emit("rond", ok=True)  # line 10: typo'd kind
+    log.emit(kind="not_a_kind")  # line 11: unknown kind, keyword form

@@ -1,0 +1,14 @@
+"""A round-program body smuggling host callbacks — the jaxpr auditor's
+forbidden-primitive check (analysis/program_audit) must flag both.
+Loaded by tests/test_analysis.py via importlib; never imported by the
+package.
+"""
+import jax
+import numpy as np
+
+
+def leaky_round(x):
+    jax.debug.callback(lambda v: None, x.sum())
+    return jax.pure_callback(
+        lambda v: np.asarray(v) * 2,
+        jax.ShapeDtypeStruct(x.shape, x.dtype), x)

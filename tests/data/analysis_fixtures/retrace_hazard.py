@@ -1,0 +1,26 @@
+"""Seeded violations for the ``retrace-hazard`` rule.
+
+tests/test_analysis.py asserts the exact rule id + line numbers below —
+append to this file, never insert lines.
+"""
+import jax
+
+step = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+
+def jit_in_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v * 2)(x))  # line 14: fresh program
+    return out
+
+
+def scalar_into_static(x, scale):
+    return step(x, float(scale))  # line 19: new signature per value
+
+
+def set_order(weights):
+    total = 0.0
+    for key in set(weights):  # line 24: nondeterministic order
+        total += weights[key]
+    return total

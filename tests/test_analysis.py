@@ -1,0 +1,344 @@
+"""Static-analysis subsystem (ISSUE 5): the rule framework, each rule
+against its seeded-violation fixture (exact rule id + line), the
+clean-tree zero-findings gate, the live allowlist resolution, the
+jaxpr/HLO program auditor over all three executors, and the dynamic
+retrace guard."""
+
+import importlib.util
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from attackfl_tpu.analysis import run_rules
+from attackfl_tpu.analysis.ast_rules import (
+    ALLOWED_FUNCTIONS,
+    donation_after_use_findings,
+    emit_kind_findings,
+    host_sync_findings,
+    resolve_host_sync_allowlist,
+    retrace_hazard_findings,
+)
+from attackfl_tpu.analysis.cli import build_report
+from attackfl_tpu.analysis import program_audit
+from attackfl_tpu.analysis.retrace import RetraceGuard, run_with_guard
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "data" / "analysis_fixtures"
+
+
+def load_fixture_module(name: str):
+    spec = importlib.util.spec_from_file_location(name, FIXTURES / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+# ---------------------------------------------------------------------------
+# the clean-tree gate
+# ---------------------------------------------------------------------------
+
+
+def test_clean_tree_has_zero_findings():
+    """Every AST/artifact rule over the real tree: zero findings.  This is
+    the regression gate the fixtures below prove is non-vacuous."""
+    findings = run_rules()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: exact rule id + line
+# ---------------------------------------------------------------------------
+
+
+def test_donation_after_use_fixture():
+    findings = donation_after_use_findings(FIXTURES / "donation_after_use.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("donation-after-use", 13), ("donation-after-use", 25)]
+    assert "`stacked`" in findings[0].message
+    assert "donated" in findings[0].message
+    # clean_rebind (donated name rebound from the call's result) is NOT
+    # flagged — exactly the fused_step multi-epoch donation pattern
+    assert not any(f.line in range(17, 21) for f in findings)
+
+
+def test_donation_conditional_argnums_not_tracked(tmp_path):
+    """The engine's conditional donation (`() if numerics else (1,)`) is a
+    host-level decision — the AST rule must not false-positive on it (the
+    jaxpr auditor covers the actual aliasing)."""
+    path = tmp_path / "engine_like.py"
+    path.write_text(
+        "import jax\n"
+        "class S:\n"
+        "    def build(self, on):\n"
+        "        self.agg = jax.jit(lambda p, s: p,\n"
+        "                           donate_argnums=() if on else (1,))\n"
+        "    def round(self, p, s):\n"
+        "        out = self.agg(p, s)\n"
+        "        return out, s.sum()\n")
+    assert donation_after_use_findings(path) == []
+
+
+def test_retrace_hazard_fixture():
+    findings = retrace_hazard_findings(FIXTURES / "retrace_hazard.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("retrace-hazard", 14), ("retrace-hazard", 19),
+        ("retrace-hazard", 24)]
+    assert "fresh program" in findings[0].message
+    assert "static_argnums" in findings[1].message
+    assert "set" in findings[2].message
+
+
+def test_emit_kind_fixture():
+    findings = emit_kind_findings(FIXTURES / "emit_kind.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("emit-kind", 10), ("emit-kind", 11)]
+    assert "'rond'" in findings[0].message
+    assert "'not_a_kind'" in findings[1].message
+
+
+def test_emit_kind_table_matches_schema():
+    """KINDS_BY_VERSION and REQUIRED_FIELDS must agree — a new kind needs
+    both (the emit-kind rule validates against their union)."""
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, REQUIRED_FIELDS, SCHEMA_VERSION, known_kinds)
+
+    assert known_kinds() == frozenset(REQUIRED_FIELDS)
+    assert set(KINDS_BY_VERSION) == set(range(1, SCHEMA_VERSION + 1))
+    with pytest.raises(ValueError):
+        known_kinds(SCHEMA_VERSION + 1)
+
+
+def test_host_sync_fixture_still_fires(tmp_path):
+    """The migrated host-sync rule (basename-keyed allowlist) behaves like
+    the original script did."""
+    bad = tmp_path / "engine.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def hot_loop(x):\n"
+        "    return float(x), np.asarray(x)\n")
+    findings = host_sync_findings(bad)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("host-sync", 3), ("host-sync", 3)]
+
+
+def test_allowlist_drift_fails_with_clear_message(monkeypatch):
+    """ISSUE 5 satellite: an allowlisted symbol that no longer exists in
+    the live module is itself a finding — the audited-transfer budget
+    cannot silently drift."""
+    assert resolve_host_sync_allowlist() == []  # live tree resolves
+    monkeypatch.setitem(
+        ALLOWED_FUNCTIONS, "engine.py",
+        set(ALLOWED_FUNCTIONS["engine.py"]) | {"Simulator._renamed_away"})
+    findings = resolve_host_sync_allowlist()
+    assert len(findings) == 1
+    assert findings[0].rule == "host-sync"
+    assert "Simulator._renamed_away" in findings[0].message
+    assert "no longer exists" in findings[0].message
+    # and the legacy script entry point fails the same way
+    import importlib.util as ilu
+    spec = ilu.spec_from_file_location(
+        "check_host_sync", REPO / "scripts" / "check_host_sync.py")
+    lint = ilu.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    monkeypatch.setitem(
+        lint.ALLOWED_FUNCTIONS, "engine.py",
+        set(lint.ALLOWED_FUNCTIONS["engine.py"]) | {"Simulator._renamed_away"})
+    assert lint.main([]) == 1
+
+
+# ---------------------------------------------------------------------------
+# jaxpr/HLO program auditor
+# ---------------------------------------------------------------------------
+
+
+def test_forbidden_callback_fixture_is_flagged():
+    fixture = load_fixture_module("forbidden_callback")
+    x = jnp.ones((4,), jnp.float32)
+    report = program_audit.audit_program(
+        "leaky", "sync", fixture.leaky_round, jax.jit(fixture.leaky_round),
+        (x,), ())
+    assert not report.ok
+    assert "pure_callback" in report.forbidden
+    assert "debug_callback" in report.forbidden
+    assert any("forbidden" in p for p in report.problems)
+
+
+def test_wide_dtype_is_flagged():
+    """The f32->f64 promotion detector fires on a jaxpr carrying wide
+    values (the executor audits assert the real programs count zero)."""
+    def promotes(x):
+        with jax.experimental.enable_x64():
+            wide = jnp.asarray(x, jnp.float64)
+            return wide + jnp.asarray(1.0, jnp.float64)
+
+    jaxpr = jax.make_jaxpr(promotes)(jnp.ones((4,), jnp.float32))
+    assert program_audit.wide_dtype_outputs(jaxpr) > 0
+
+    def stays_narrow(x):
+        return x * 2.0
+
+    narrow = jax.make_jaxpr(stays_narrow)(jnp.ones((4,), jnp.float32))
+    assert program_audit.wide_dtype_outputs(narrow) == 0
+
+
+def test_program_audit_all_three_executors():
+    """Acceptance gate: the auditor verifies donation aliasing and zero
+    forbidden callback primitives for the sync, fused and pipelined
+    executors on the CPU-sized representative config."""
+    reports = program_audit.audit_default_programs()
+    by_executor = {}
+    for r in reports:
+        by_executor.setdefault(r.executor, []).append(r)
+    assert set(by_executor) == {"sync", "fused", "pipelined"}
+    for r in reports:
+        assert r.ok, f"{r.name}: {r.problems}"
+        assert r.forbidden == []
+        assert r.f64_outputs == 0
+        assert r.aliased_leaves == r.expected_aliases
+    # the fused/pipelined state donation really aliases: every donated
+    # state leaf has a same-shaped output and every one is aliased
+    for executor in ("fused", "pipelined"):
+        (r,) = by_executor[executor]
+        assert r.donated_leaves > 0
+        assert r.aliased_leaves == r.donated_leaves
+    # sync aggregate donates the (C, P) stacked tree for early-free: no
+    # same-shaped output exists, so expected == aliased == 0 — the
+    # auditor distinguishes that from a donation that silently stopped
+    # aliasing
+    agg = next(r for r in reports if "aggregate" in r.name)
+    assert agg.donated_leaves > 0 and agg.expected_aliases == 0
+
+
+def test_donation_spec_matches_programs():
+    """The engine's declared donation policy is what audit_programs hands
+    the auditor — and flipping numerics flips the sync-path donation."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = audit_config()
+    sim = Simulator(cfg)
+    try:
+        spec = sim.donation_spec()
+        assert spec["aggregate"] == (1,)
+        programs = {p["name"]: p for p in sim.audit_programs()}
+        assert programs["aggregate"]["donate"] == (1,)
+        assert programs["fused_chunk[2]"]["donate"] == (0,)
+    finally:
+        sim.close()
+    cfg_num = audit_config(telemetry=cfg.telemetry.__class__(
+        enabled=True, numerics=True))
+    sim_num = Simulator(cfg_num)
+    try:
+        # numerics reads `stacked` after aggregation on the sync path, so
+        # the declared policy must drop the donation there
+        assert sim_num.donation_spec()["aggregate"] == ()
+    finally:
+        sim_num.close()
+
+
+def test_transfer_budget_reports_resolved_allowlist():
+    budget = program_audit.transfer_budget()
+    assert budget["resolved"] is True
+    assert budget["total"] == sum(
+        len(q) for q in budget["audited_functions"].values())
+    assert "NumericsDrainer.drain" in budget["audited_functions"]["numerics.py"]
+
+
+# ---------------------------------------------------------------------------
+# retrace guard
+# ---------------------------------------------------------------------------
+
+
+class _FakeSim:
+    def __init__(self):
+        self.f = jax.jit(lambda x: x + 1)
+        self._fused_cache = {}
+        self._pipeline_cache = {}
+        self.validation = None
+
+
+def test_retrace_guard_catches_a_retrace():
+    sim = _FakeSim()
+    sim.f(jnp.ones((2,)))
+    guard = RetraceGuard(sim)
+    guard.snapshot()
+    assert guard.violations() == []
+    sim.f(jnp.ones((3,)))  # new shape -> retrace
+    (violation,) = guard.violations()
+    assert "retraced after round 1" in violation and "f" in violation
+
+
+def test_retrace_guard_requires_snapshot():
+    with pytest.raises(RuntimeError):
+        RetraceGuard(_FakeSim()).violations()
+
+
+def test_no_retrace_across_sync_and_pipelined_runs():
+    """The real engine: every jitted program traces during round 1 and
+    never again over a 3-round run, on both the synchronous and pipelined
+    executors (the fused executor is covered by run_fast's chunk-cache
+    telemetry and shares the pipelined body)."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    for pipeline in (False, True):
+        sim = Simulator(audit_config())
+        try:
+            violations = run_with_guard(sim, num_rounds=3, pipeline=pipeline)
+            assert violations == [], (pipeline, violations)
+        finally:
+            sim.close()
+
+
+# ---------------------------------------------------------------------------
+# the audit CLI report
+# ---------------------------------------------------------------------------
+
+
+def test_audit_report_fast_path_is_clean():
+    report = build_report(skip_programs=True)
+    assert report["ok"] is True
+    assert report["findings"] == []
+    assert {r["id"] for r in report["rules"]} == {
+        "host-sync", "donation-after-use", "retrace-hazard", "emit-kind",
+        "event-schema"}
+
+
+def test_golden_report_format():
+    """tests/data/audit_report.json is the committed format corpus: the
+    current code must produce the same document structure (values drift
+    with the code — asserted clean, not byte-equal)."""
+    golden = json.loads((REPO / "tests" / "data" /
+                         "audit_report.json").read_text())
+    fresh = build_report(skip_programs=True)
+    assert sorted(golden) == sorted(fresh) == [
+        "findings", "ok", "programs", "rules", "schema", "tool",
+        "transfer_budget"]
+    assert golden["schema"] == fresh["schema"]
+    assert golden["ok"] is True and golden["findings"] == []
+    assert {r["id"] for r in golden["rules"]} == {
+        r["id"] for r in fresh["rules"]}
+    assert len(golden["programs"]) >= 4
+    program_keys = {"name", "executor", "ok", "eqns", "distinct_primitives",
+                    "forbidden_primitives", "donated_args", "donated_leaves",
+                    "expected_aliases", "aliased_leaves", "f64_outputs",
+                    "problems"}
+    for p in golden["programs"]:
+        assert set(p) == program_keys
+        assert p["ok"] is True
+    assert golden["transfer_budget"]["resolved"] is True
+
+
+def test_audit_cli_exit_codes(capsys):
+    from attackfl_tpu.analysis.cli import audit_main
+
+    assert audit_main(["--list-rules"]) == 0
+    assert audit_main(["--skip-programs"]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s) — OK" in out
+    assert audit_main(["--skip-programs", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
