@@ -53,6 +53,14 @@ NUMERICS_FILES = (
     REPO / "attackfl_tpu" / "ops" / "metrics.py",
     REPO / "attackfl_tpu" / "telemetry" / "numerics.py",
 )
+# the fault-injection harness (ISSUE 6): the device-side mask builders
+# compile the plan into the round program and must be traced-only (NO
+# allowlisted functions by design — injection may never add a host sync
+# to the round hot path); the host injector only touches host values
+FAULTS_FILES = (
+    REPO / "attackfl_tpu" / "faults" / "plan.py",
+    REPO / "attackfl_tpu" / "faults" / "inject.py",
+)
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -219,7 +227,8 @@ def resolve_host_sync_allowlist() -> list[Finding]:
 
 
 def host_sync_files() -> list[Path]:
-    return sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
+    return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
+            + list(FAULTS_FILES))
 
 
 @register(

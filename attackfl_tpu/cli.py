@@ -145,6 +145,21 @@ def server_main(argv=None) -> None:
                         help="background checkpoint writer: serialize + "
                              "write + fsync off the round loop "
                              "(server.checkpoint-async)")
+    parser.add_argument("--resume", action="store_true",
+                        help="continue from the checkpoint directory's "
+                             "manifest.json: newest valid entry wins, "
+                             "torn/truncated entries fall back to the "
+                             "previous good one, round numbering continues "
+                             "(server.resume)")
+    parser.add_argument("--inject-faults", type=str, default=None,
+                        metavar="PLAN",
+                        help="deterministic fault plan, e.g. "
+                             "'nan_storm@3:clients=0,1;ckpt_write_error@2:"
+                             "count=2;writer_death@4;monitor_stall@5' "
+                             "(kinds: nan_storm dropout ckpt_write_error "
+                             "ckpt_torn writer_death monitor_stall; "
+                             "config `faults:` section takes the same "
+                             "entries as mappings)")
     parser.add_argument("--validation-every", type=int, default=None,
                         metavar="K",
                         help="validate every K-th broadcast "
@@ -227,6 +242,12 @@ def server_main(argv=None) -> None:
         perf_overrides["pipeline"] = True
     if args.checkpoint_async:
         perf_overrides["checkpoint_async"] = True
+    if args.resume:
+        perf_overrides["resume"] = True
+    if args.inject_faults is not None:
+        from attackfl_tpu.faults.plan import parse_fault_plan
+
+        perf_overrides["faults"] = parse_fault_plan(args.inject_faults)
     if args.validation_every is not None:
         perf_overrides["validation_every"] = args.validation_every
     if args.validation_async:
@@ -305,6 +326,7 @@ def watch_main(argv=None) -> int:
 
     seen_round = object()
     stalled = False
+    degraded = False
     while True:
         try:
             code, health = _http_get_json(base + "/healthz")
@@ -326,6 +348,17 @@ def watch_main(argv=None) -> int:
             stalled = True
         else:
             stalled = False
+        # degraded ≠ stalled ≠ healthy: the pipelined executor demoted to
+        # depth-0 after consecutive rollbacks — progressing, but flagged
+        if health.get("status") == "degraded":
+            if not degraded:
+                print_with_color(
+                    f"[watch] executor DEGRADED: {health}", "yellow")
+            degraded = True
+        elif degraded and code != 503:
+            print_with_color("[watch] executor re-promoted (healthy)",
+                             "cyan")
+            degraded = False
         rnd = last.get("round")
         if last and rnd != seen_round:
             seen_round = rnd

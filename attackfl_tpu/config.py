@@ -18,6 +18,8 @@ from typing import Any, Sequence
 
 import yaml
 
+from attackfl_tpu.faults.plan import FaultSpec, faults_from_config
+
 # Server aggregation modes, matching the reference's dispatch strings
 # (reference: server.py:287-494).  "fltracer" was dead code there
 # (server.py:395-435) but is live here.
@@ -228,8 +230,28 @@ class Config:
     # Background checkpoint persistence (utils/checkpoint
     # AsyncCheckpointWriter): the device->host gather stays on the round
     # loop, serialization + file write + fsync move to a writer thread
-    # with last-write-wins coalescing and a drain-on-close guarantee.
+    # with last-write-wins coalescing, a drain-on-close guarantee and a
+    # supervisor that restarts a dead writer thread.
     checkpoint_async: bool = False
+    # Resume from the checkpoint directory's manifest.json (ISSUE 6): the
+    # newest VALID entry is restored (torn/truncated entries detected by
+    # content hash and skipped with fallback to the previous good one),
+    # a `resume` event records the boundary, and round numbering
+    # continues from the checkpointed round (exactly-once accounting).
+    # `load_parameters` keeps the legacy single-file reload.
+    resume: bool = False
+    # Manifest retention: how many round-stamped checkpoint entries stay
+    # on disk (utils/checkpoint.CheckpointManager).  More entries = more
+    # torn-file fallback depth at ~one state size each.
+    checkpoint_keep: int = 3
+    # Graceful executor degradation (ISSUE 6): the pipelined executor
+    # demotes to depth-0 (resolve-before-dispatch) after this many
+    # consecutive device-side rollbacks ...
+    pipeline_demote_after: int = 3
+    # ... and re-promotes to depth-1 after this many consecutive clean
+    # rounds.  Both transitions emit `degrade` events and flip the live
+    # monitor's degraded state.
+    pipeline_repromote_after: int = 5
     num_data_range: tuple[int, int] = (12000, 15000)
     genuine_rate: float = 0.5
     random_seed: int = 1
@@ -279,6 +301,14 @@ class Config:
 
     # --- attackers ---
     attacks: tuple[AttackSpec, ...] = ()
+
+    # --- fault injection (ISSUE 6) ---
+    # Deterministic scheduled failures (YAML `faults:` section / CLI
+    # `--inject-faults`): NaN storms + forced-dropout cohorts compiled
+    # into the jitted round program, checkpoint write errors / torn
+    # files / writer-thread death / monitor stalls injected at the host
+    # seams (attackfl_tpu/faults).  Empty = no injection anywhere.
+    faults: tuple[FaultSpec, ...] = ()
 
     # --- infra ---
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -333,6 +363,21 @@ class Config:
                 f"validation_every must be >= 1 (1 = every round; disable "
                 f"validation with validation: false), got {self.validation_every}"
             )
+        if self.checkpoint_keep < 1:
+            raise ValueError(
+                f"checkpoint_keep must be >= 1 (manifest retention depth), "
+                f"got {self.checkpoint_keep}")
+        if self.pipeline_demote_after < 1 or self.pipeline_repromote_after < 1:
+            raise ValueError(
+                "pipeline_demote_after and pipeline_repromote_after must be "
+                f">= 1, got {self.pipeline_demote_after} / "
+                f"{self.pipeline_repromote_after}")
+        for spec in self.faults:
+            for cid in spec.clients:
+                if not 0 <= cid < self.total_clients:
+                    raise ValueError(
+                        f"fault {spec.kind}@{spec.round}: client {cid} out "
+                        f"of range [0, {self.total_clients})")
         if self.reload_parameters_per_round and not self.load_parameters:
             raise ValueError(
                 "reload_parameters_per_round replicates the reference's "
@@ -501,6 +546,14 @@ def config_from_dict(raw: dict) -> Config:
         pipeline=bool(_get(server, "pipeline", defaults.pipeline)),
         checkpoint_async=bool(_get(server, "checkpoint-async",
                                    defaults.checkpoint_async)),
+        resume=bool(_get(server, "resume", defaults.resume)),
+        checkpoint_keep=int(_get(server, "checkpoint-keep",
+                                 defaults.checkpoint_keep)),
+        pipeline_demote_after=int(_get(server, "pipeline-demote-after",
+                                       defaults.pipeline_demote_after)),
+        pipeline_repromote_after=int(_get(
+            server, "pipeline-repromote-after",
+            defaults.pipeline_repromote_after)),
         num_data_range=(int(ndr[0]), int(ndr[1])),
         genuine_rate=float(_get(server, "genuine-rate", defaults.genuine_rate)),
         random_seed=int(_get(server, "random-seed", defaults.random_seed) or 0),
@@ -527,6 +580,7 @@ def config_from_dict(raw: dict) -> Config:
         batch_size=int(_get(learning, "batch-size", defaults.batch_size)),
         clip_grad_norm=float(_get(learning, "clip-grad-norm", defaults.clip_grad_norm)),
         attacks=tuple(attacks),
+        faults=faults_from_config(_get(raw, "faults", []) or []),
         mesh=MeshConfig(
             num_devices=int(_get(mesh, "num-devices", 0)),
             axis_name=str(_get(mesh, "axis-name", "clients")),

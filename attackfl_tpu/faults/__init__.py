@@ -1,0 +1,26 @@
+"""Seeded, plan-driven fault injection + the recovery layer that
+survives it (ISSUE 6 tentpole).
+
+``plan`` declares *what* fails and *when* (a :class:`FaultSpec` per
+failure, scheduled on the deterministic broadcast/round clocks so chaos
+runs replay bit-identically); ``inject`` implements *how*: device-side
+builders that compile NaN storms and forced-dropout cohorts into the
+jitted round program through the existing ok-flag path, plus the
+:class:`HostFaultInjector` the checkpoint/monitor layers consult for
+write errors, torn files, writer-thread death and watchdog stalls.
+
+Everything here only ever makes things fail — the recovery machinery it
+exercises (manifest checkpoints with torn-file fallback, the async-writer
+supervisor, retry-with-backoff, pipelined-executor demotion) lives with
+the subsystems it hardens (``utils/checkpoint.py``,
+``training/engine.py``) and runs whether or not a fault plan is loaded.
+"""
+
+from attackfl_tpu.faults.plan import (  # noqa: F401
+    DEVICE_FAULT_KINDS,
+    FAULT_KINDS,
+    HOST_FAULT_KINDS,
+    FaultSpec,
+    faults_from_config,
+    parse_fault_plan,
+)

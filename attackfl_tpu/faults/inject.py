@@ -1,0 +1,194 @@
+"""Fault injection: device-side mask builders + the host-side injector.
+
+Device side: :func:`build_client_fault_fn` resolves a plan's
+``nan_storm``/``dropout`` specs at program-BUILD time into two static
+arrays (per-spec fire rounds + per-spec client masks) and returns a pure
+traced function ``broadcast_number -> (C,) bool`` — the jitted round
+program then carries the whole schedule as constants and a handful of
+compares/selects, so the synchronous, fused and pipelined executors all
+inject identically with zero host work per round.  Everything in this
+file that runs under trace is sync-free (held to the host-sync lint like
+the training package).
+
+Host side: :class:`HostFaultInjector` is the single object the
+checkpoint manager, the async-writer wiring and the round loops consult.
+It owns the consumable fault state (remaining ``ckpt_write_error``
+counts, fired-once latches) and emits the schema-v4 ``fault`` event for
+every injection so a chaos run's event log is its own ground truth.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attackfl_tpu.faults.plan import DEVICE_FAULT_KINDS, FaultSpec, device_specs
+
+
+def build_client_fault_fn(
+    plan: Sequence[FaultSpec], num_clients: int, kind: str
+) -> Callable[[jnp.ndarray], jnp.ndarray] | None:
+    """``broadcast_number -> (C,) bool`` fire mask for one device-side
+    kind, or None when the plan schedules none (the round program then
+    contains no injection ops at all)."""
+    specs = device_specs(plan, kind)
+    if not specs:
+        return None
+    rounds = np.zeros((len(specs),), np.int32)
+    masks = np.zeros((len(specs), num_clients), bool)
+    for i, spec in enumerate(specs):
+        rounds[i] = spec.round
+        if spec.clients:
+            for cid in spec.clients:
+                if not 0 <= cid < num_clients:
+                    raise ValueError(
+                        f"fault {kind}@{spec.round}: client {cid} out of "
+                        f"range [0, {num_clients})")
+                masks[i, cid] = True
+        else:
+            masks[i, :] = True  # empty cohort = every client
+    rounds_arr = jnp.asarray(rounds)
+    masks_arr = jnp.asarray(masks)
+
+    def fire_mask(broadcast_number: jnp.ndarray) -> jnp.ndarray:
+        hit = broadcast_number == rounds_arr  # (k,)
+        return jnp.any(hit[:, None] & masks_arr, axis=0)  # (C,)
+
+    return fire_mask
+
+
+def apply_nan_storm(storm: jnp.ndarray, stacked: Any, ok: jnp.ndarray
+                    ) -> tuple[Any, jnp.ndarray]:
+    """Overwrite stormed clients' stacked deltas with NaN and clear their
+    ok flags — the same per-client failure shape a genuinely diverging
+    client produces, so every downstream guard (train_ok, leak-pool
+    select, accept-select rollback, non-finite numerics provenance) is
+    exercised through its existing path."""
+
+    def poison(x):
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            return x  # integer leaves (none today) cannot hold NaN
+        sel = storm.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(sel, jnp.asarray(jnp.nan, x.dtype), x)
+
+    return jax.tree.map(poison, stacked), ok & ~storm
+
+
+class HostFaultInjector:
+    """Plan-driven host-side failures, consulted at the persistence and
+    monitoring seams.
+
+    Construction is cheap and side-effect free; each ``maybe_*`` method
+    is a no-op unless the plan armed that kind for the given round.
+    Injections fire exactly once per (kind, round) — except
+    ``ckpt_write_error``, which fails ``count`` consecutive attempts —
+    and every firing emits a ``fault`` event (``action="injected"``)
+    plus a ``faults_injected`` counter bump.  Methods may be called from
+    the async writer thread; the event log is lock-serialized and the
+    consumable state is only ever touched under the caller's
+    single-writer discipline.
+    """
+
+    def __init__(self, plan: Sequence[FaultSpec], telemetry):
+        self._tel = telemetry
+        self._plan = tuple(plan)
+        self._write_errors: dict[int, int] = {}
+        for spec in self._plan:
+            if spec.kind == "ckpt_write_error":
+                self._write_errors[spec.round] = spec.count
+        self._fired: set[tuple[str, int]] = set()
+        self._device_noted: set[tuple[str, int]] = set()
+
+    def describe(self) -> list[dict[str, Any]]:
+        """JSON-ready plan for the telemetry run header."""
+        return [spec.describe() for spec in self._plan]
+
+    def _specs(self, kind: str, round_no: int) -> list[FaultSpec]:
+        return [s for s in self._plan
+                if s.kind == kind and s.round == round_no]
+
+    def _emit(self, kind: str, round_no: int, **details: Any) -> None:
+        self._tel.counters.inc("faults_injected")
+        self._tel.events.emit("fault", fault=kind, action="injected",
+                              round=round_no, **details)
+
+    # ---- device-side bookkeeping ------------------------------------
+    def note_round_resolved(self, broadcast_number: int) -> None:
+        """Record device-side injections once their round resolves on
+        host.  The injection itself already happened inside the jitted
+        program; this writes the plan's ground truth next to the round
+        event so forensics never has to re-derive the schedule."""
+        for kind in DEVICE_FAULT_KINDS:
+            for spec in self._specs(kind, broadcast_number):
+                key = (kind, broadcast_number)
+                if key in self._device_noted:
+                    continue
+                self._device_noted.add(key)
+                self._emit(kind, broadcast_number,
+                           clients=list(spec.clients), device_side=True)
+
+    # ---- checkpoint seams -------------------------------------------
+    def on_checkpoint_write(self, round_no: int) -> None:
+        """Called at the top of every checkpoint write ATTEMPT (inside
+        the manager's retry loop).  Raises OSError while the armed
+        ``ckpt_write_error`` budget for this round lasts."""
+        for armed_round, remaining in list(self._write_errors.items()):
+            if round_no >= armed_round and remaining > 0:
+                self._write_errors[armed_round] = remaining - 1
+                self._emit("ckpt_write_error", round_no,
+                           remaining=remaining - 1)
+                raise OSError(
+                    f"injected checkpoint write error (fault plan, "
+                    f"round {round_no})")
+
+    def after_checkpoint_write(self, round_no: int, entry_path: str) -> None:
+        """Called after a round's entry file is durably recorded.  A
+        ``ckpt_torn`` spec truncates the file to half its bytes — the
+        manifest keeps the full-content hash, so loads must reject the
+        entry and fall back."""
+        for _spec in self._specs("ckpt_torn", round_no):
+            key = ("ckpt_torn", round_no)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            try:
+                import os
+
+                size = os.path.getsize(entry_path)
+                with open(entry_path, "r+b") as fh:
+                    fh.truncate(max(size // 2, 1))
+            except OSError:
+                continue  # nothing to tear (write itself failed)
+            self._emit("ckpt_torn", round_no, path=entry_path,
+                       truncated_to=max(size // 2, 1), original_bytes=size)
+
+    def maybe_kill_writer(self, round_no: int, writer) -> None:
+        """Kill the async checkpoint writer thread when armed (the
+        supervisor inside :class:`AsyncCheckpointWriter` restarts it on
+        the next submit/drain)."""
+        if writer is None:
+            return
+        for _spec in self._specs("writer_death", round_no):
+            key = ("writer_death", round_no)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            writer.inject_thread_death()
+            self._emit("writer_death", round_no)
+
+    # ---- monitor seam -----------------------------------------------
+    def maybe_stall_monitor(self, round_no: int, monitor) -> None:
+        """Rewind the watchdog heartbeat past its threshold so the stall
+        path (503 /healthz, ``stall`` event) fires deterministically."""
+        if monitor is None:
+            return
+        for _spec in self._specs("monitor_stall", round_no):
+            key = ("monitor_stall", round_no)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            seconds = monitor.simulate_hang()
+            self._emit("monitor_stall", round_no, rewound_seconds=seconds)

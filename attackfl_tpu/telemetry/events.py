@@ -35,6 +35,18 @@ Schema v3 (ISSUE 4) extends v2 — every v1/v2 file still validates:
   optional and type-checked only when present (v1/v2 ``metric`` records
   carry none of them).
 
+Schema v4 (ISSUE 6) extends v3 — every v1/v2/v3 file still validates:
+
+* ``fault`` — the fault-injection harness's ground truth: one record per
+  injected failure (``fault`` = kind, ``action`` = injected/recovered)
+  from :mod:`attackfl_tpu.faults`;
+* ``degrade`` — the pipelined executor's graceful-degradation state
+  machine (``state`` = demoted/repromoted after k consecutive rollbacks
+  / m clean rounds);
+* ``resume`` — a crash-safe resume boundary: the run continues from
+  ``round`` restored from the manifest entry at ``path`` (round numbers
+  in the resumed run continue from there — exactly-once accounting).
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -51,7 +63,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -80,6 +92,14 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
                     "kept": list, "removed": list},
     # jax.profiler --profile-rounds window markers
     "profile": {"action": str},
+    # --- schema v4 kinds (ISSUE 6) ---
+    # fault-injection ground truth (attackfl_tpu/faults): one record per
+    # injected failure or supervised recovery
+    "fault": {"fault": str, "action": str},
+    # pipelined-executor graceful degradation: demoted/repromoted
+    "degrade": {"state": str, "round": int},
+    # crash-safe resume boundary (manifest-driven `--resume`)
+    "resume": {"round": int, "path": str},
 }
 
 # --- schema v3: optional numerics payload on `metric` events ---
@@ -100,6 +120,7 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
                   "run_end", "metric"}),
     2: frozenset({"stall", "attribution", "profile"}),
     3: frozenset(),  # v3 only adds optional fields on `metric`
+    4: frozenset({"fault", "degrade", "resume"}),
 }
 
 

@@ -78,6 +78,11 @@ class RunMonitor:
         self._active = False  # watchdog only arms between run start/end
         self._stalled = False
         self._stall_info: dict[str, Any] = {}
+        # graceful-degradation surface (ISSUE 6): set by the pipelined
+        # executor when it demotes to depth-0 — a third health state,
+        # distinct from both healthy (200 ok) and stalled (503): the run
+        # IS making progress, just without pipelining
+        self._degraded: dict[str, Any] | None = None
         self._server: ThreadingHTTPServer | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -162,6 +167,24 @@ class RunMonitor:
             self._stalled = False
             self._stall_info = {}
 
+    def set_degraded(self, info: dict[str, Any] | None) -> None:
+        """Flip the executor-degradation flag (``info`` carries the
+        evidence — round, consecutive failures; None = re-promoted)."""
+        with self._lock:
+            self._degraded = dict(info) if info else None
+
+    def simulate_hang(self) -> float:
+        """Fault injection (``monitor_stall``): rewind the heartbeat past
+        the stall threshold and run one watchdog tick, so the stall path
+        (503 + ``stall`` event) fires deterministically.  Returns the
+        rewind in seconds."""
+        seconds = self.stall_threshold_seconds() + 1.0
+        with self._lock:
+            if self._last_beat is not None:
+                self._last_beat -= seconds
+        self.check_stall()
+        return seconds
+
     def update_numerics(self, gauges: dict[str, Any]) -> None:
         """Record the latest drained numerics row (non-finite gauges
         arrive as None and are skipped — Prometheus gauges are numbers)."""
@@ -218,9 +241,18 @@ class RunMonitor:
     # ------------------------------------------------------------------
 
     def health(self) -> tuple[int, dict[str, Any]]:
+        """Three distinct states: stalled (503 — no progress at all),
+        degraded (200 — progressing without pipelining), healthy (200)."""
         with self._lock:
             if self._stalled:
                 return 503, {"status": "stalled", **self._stall_info}
+            if self._degraded is not None:
+                return 200, {
+                    "status": "degraded",
+                    "active": self._active,
+                    "rounds_completed": self._rounds_completed,
+                    **self._degraded,
+                }
             return 200, {
                 "status": "ok",
                 "active": self._active,
@@ -243,11 +275,14 @@ class RunMonitor:
             numerics = dict(self._last_numerics)
             rounds = self._rounds_completed
             stalled = int(self._stalled)
+            degraded = int(self._degraded is not None)
         lines = [
             "# TYPE attackfl_rounds_completed counter",
             f"attackfl_rounds_completed {rounds}",
             "# TYPE attackfl_stalled gauge",
             f"attackfl_stalled {stalled}",
+            "# TYPE attackfl_degraded gauge",
+            f"attackfl_degraded {degraded}",
             "# TYPE attackfl_stall_threshold_seconds gauge",
             f"attackfl_stall_threshold_seconds "
             f"{self.stall_threshold_seconds():.6f}",

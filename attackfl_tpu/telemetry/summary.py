@@ -94,6 +94,11 @@ def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
     chunks = [e for e in events if e.get("kind") == "chunk"]
     compiles = [e for e in events if e.get("kind") == "compile"]
     retries = [e for e in events if e.get("kind") == "retry"]
+    # schema v4 (ISSUE 6): fault-injection ground truth, executor
+    # degradation transitions, and the crash-safe resume boundary
+    faults = [e for e in events if e.get("kind") == "fault"]
+    degrades = [e for e in events if e.get("kind") == "degrade"]
+    resume = next((e for e in events if e.get("kind") == "resume"), None)
     counters = next((e["counters"] for e in reversed(events)
                      if e.get("kind") == "counters"), None)
     run_end = next((e for e in reversed(events)
@@ -175,6 +180,17 @@ def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
         "run_end": ({k: run_end.get(k) for k in ("rounds", "ok_rounds", "seconds")}
                     if run_end else None),
         "skipped_lines": skipped,
+        # run-lifecycle robustness (schema v4): present even when empty so
+        # the JSON shape is stable across fault-free and chaos runs
+        "faults": [{k: f.get(k) for k in ("fault", "action", "round")
+                    if f.get(k) is not None} for f in faults],
+        "degrades": [{k: d.get(k)
+                      for k in ("state", "round", "consecutive_failures")
+                      if d.get(k) is not None} for d in degrades],
+        "resumed_from": ({"round": resume.get("round"),
+                          "path": resume.get("path"),
+                          "source_run_id": resume.get("source_run_id")}
+                         if resume else None),
     }
 
 
@@ -190,6 +206,26 @@ def format_summary(summary: dict[str, Any]) -> str:
     lines.append(
         f"rounds: {summary['rounds_attempted']} attempted, "
         f"{summary['rounds_ok']} ok, {summary['retries']} retried")
+    resumed = summary.get("resumed_from")
+    if resumed:
+        lines.append(
+            f"resumed: from round {resumed['round']} "
+            f"({resumed.get('path') or 'manifest'}) — round numbers "
+            "continue from there")
+    if summary.get("faults"):
+        injected = [f for f in summary["faults"]
+                    if f.get("action") == "injected"]
+        recovered = [f for f in summary["faults"]
+                     if f.get("action") == "recovered"]
+        kinds = sorted({f.get("fault", "?") for f in injected})
+        lines.append(
+            f"faults: {len(injected)} injected"
+            + (f" ({', '.join(kinds)})" if kinds else "")
+            + (f", {len(recovered)} recovered" if recovered else ""))
+    for transition in summary.get("degrades") or []:
+        lines.append(
+            f"degrade: {transition.get('state')} at round "
+            f"{transition.get('round')}")
     if summary["phases"]:
         lines.append(f"{'phase':<14}{'p50':>10}{'p95':>10}{'mean':>10}{'n':>6}")
         for name, stats in summary["phases"].items():

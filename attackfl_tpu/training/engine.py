@@ -157,6 +157,12 @@ class Simulator:
                 "stats and needs the full client matrix locally; run it "
                 "single-process (the matrices are tiny — SURVEY.md §7)"
             )
+        if self.multiprocess and cfg.resume:
+            raise ValueError(
+                "--resume restores from the host-local manifest.json and is "
+                "single-process; multi-host resume goes through "
+                "load_parameters (process-0 byte broadcast)"
+            )
         if self.multiprocess and cfg.reload_parameters_per_round:
             raise ValueError(
                 "reload_parameters_per_round re-reads a host-local file "
@@ -193,9 +199,10 @@ class Simulator:
         self._numerics_on = bool(self.telemetry.enabled
                                  and cfg.telemetry.numerics)
         self._nan_counter: Callable | None = None
-        # AOT-compiled fused chunk programs, keyed by scan length (False =
-        # AOT failed for this length; fall back to the lazy jit path)
-        self._fused_exe_cache: dict[int, Any] = {}
+        # AOT-compiled fused chunk programs, keyed by (scan length,
+        # donate) (False = AOT failed for this key; fall back to the lazy
+        # jit path)
+        self._fused_exe_cache: dict[tuple, Any] = {}
 
         # ---- live monitor (health endpoint + stall watchdog) ------------
         # Config-gated; process 0 only — one health endpoint per run, and
@@ -363,7 +370,17 @@ class Simulator:
             self._numerics_step = jax.jit(numerics_step)
 
         self._ravel_stacked = jax.jit(pt.tree_ravel_stacked)
-        self._fused_cache: dict[int, Callable] = {}
+        # State-donation safety latch (ISSUE 6): donating the carry of a
+        # run that started from a RESTORED checkpoint state corrupts
+        # memory on jax 0.4.37 when the fused/pipelined executable is a
+        # persistent-compile-cache hit (observed on CPU as NaN rounds or
+        # a hard segfault on the second dispatch; reproduced on the
+        # pre-ISSUE-6 tree with load_parameters resume + run_scan).
+        # Donation is an optimization hint, never semantics — a resumed
+        # run trades one state copy per dispatch for correctness.
+        self._state_donation_ok = True
+        # fused chunk programs, keyed by (scan length, donate)
+        self._fused_cache: dict[tuple, Callable] = {}
         # pipelined single-round programs, keyed by (include_eval, donate)
         self._pipeline_cache: dict[tuple, Callable] = {}
         self._pipeline_exe_cache: dict[tuple, Any] = {}
@@ -372,13 +389,51 @@ class Simulator:
         self._reload_cache: tuple[tuple[int, int], Any] | None = None
         # validation_async: (history entry, round, in-flight device dict)
         self._inflight_validations: list[tuple[dict, int, dict]] = []
+
+        # ---- fault-tolerant persistence (ISSUE 6) ------------------------
+        # Plan-driven host-side fault injector (None without a plan); the
+        # device-side half was already compiled into round_step above
+        # (training/round.py reads cfg.faults at build time).
+        self._fault_injector = None
+        if cfg.faults:
+            from attackfl_tpu.faults.inject import HostFaultInjector
+
+            self._fault_injector = HostFaultInjector(cfg.faults, self.telemetry)
+        # Orphaned temp files from killed/failed writes are swept before
+        # any new checkpoint activity (satellite: they used to accumulate
+        # forever).  Process 0 only under DCN — workers never write here.
+        if not self.multiprocess or jax.process_index() == 0:
+            swept = ckpt.sweep_orphans(cfg.checkpoint_dir)
+            if swept:
+                self.telemetry.counters.inc("orphan_tmp_swept", len(swept))
+                print_with_color(
+                    f"[checkpoint] swept {len(swept)} orphaned temp "
+                    f"file(s) from {cfg.checkpoint_dir or '.'}", "yellow")
+        # Durable manifest-tracked checkpoints: every save lands as a
+        # round-stamped entry + the legacy alias, recorded in
+        # manifest.json (round, config fingerprint, run_id, content hash)
+        # with last-k retention, bounded retry-with-backoff and torn-file
+        # fallback at load (utils/checkpoint.CheckpointManager).
+        self._ckpt_manager = ckpt.CheckpointManager(
+            ckpt.checkpoint_path(cfg),
+            fingerprint=ckpt.config_fingerprint(cfg),
+            run_id=self.telemetry.events.run_id,
+            keep=cfg.checkpoint_keep,
+            telemetry=self.telemetry,
+            injector=self._fault_injector,
+            fresh=not (cfg.resume or cfg.load_parameters),
+        )
+        self._resume_info: dict[str, Any] | None = None
         # checkpoint_async: background serialize+write+fsync thread; the
-        # device->host gather stays on the round loop (_save_checkpoint)
+        # device->host gather stays on the round loop (_save_checkpoint).
+        # The manager is the write_fn (manifest + retries + fail-open);
+        # a dead thread is restarted by the writer's supervisor, counted
+        # and surfaced as a `fault` recovery event.
         self._ckpt_writer = None
         if cfg.checkpoint_async:
             self._ckpt_writer = ckpt.AsyncCheckpointWriter(
-                on_write=lambda _path: self.telemetry.counters.inc(
-                    "checkpoint_writes"))
+                write_fn=self._ckpt_manager.write,
+                on_restart=self._on_writer_restart)
 
     # ------------------------------------------------------------------
     # audit hooks (attackfl_tpu/analysis — ISSUE 5)
@@ -545,13 +600,75 @@ class Simulator:
             }
         return state
 
+    def _load_resume_state(self) -> dict[str, Any] | None:
+        """``--resume``: restore the newest VALID manifest entry
+        (torn/truncated entries are detected by content hash and fall
+        back to the previous good one), stash the ``resume`` event
+        payload for :meth:`_emit_run_header`, and return the state —
+        or None when nothing valid exists (the run starts fresh, loudly).
+        """
+        result = self._ckpt_manager.load_latest(self._init_host_state())
+        rejected = [{"file": entry.get("file"), "round": entry.get("round"),
+                     "reason": reason[:200]}
+                    for entry, reason in result.rejected]
+        if rejected:
+            self.telemetry.counters.inc("checkpoint_fallbacks", len(rejected))
+            for item in rejected:
+                print_with_color(
+                    f"[resume] rejected checkpoint {item['file']}: "
+                    f"{item['reason']}", "yellow")
+        if result.state is None:
+            print_with_color(
+                "[resume] no valid checkpoint entry found under "
+                f"{self._ckpt_manager.directory!r}; starting fresh", "yellow")
+            self._resume_info = None
+            return None
+        entry = result.entry or {}
+        manifest = result.manifest or {}
+        fingerprint_match = (
+            manifest.get("fingerprint") == self._ckpt_manager.fingerprint
+            if manifest.get("fingerprint") else None)
+        if fingerprint_match is False:
+            print_with_color(
+                "[resume] config fingerprint mismatch: this checkpoint was "
+                "written under a different experiment config — resuming "
+                "anyway because the state structure matched, but verify "
+                "your config", "red")
+        state = result.state
+        round_no = int(state["completed_rounds"])
+        self._resume_info = {
+            "round": round_no,
+            "broadcast": int(state["broadcasts"]),
+            "path": os.path.join(self._ckpt_manager.directory,
+                                 str(entry.get("file", ""))),
+            "source_run_id": manifest.get("run_id", ""),
+            "fingerprint_match": fingerprint_match,
+            "rejected": rejected,
+        }
+        print_with_color(
+            f"[resume] continuing from round {round_no} "
+            f"({entry.get('file')})", "yellow")
+        self._state_donation_ok = False  # restored state: donation off
+        return self._ensure_numerics_state(state)
+
     def load_or_init_state(self) -> dict[str, Any]:
         """Resume from checkpoint when configured
         (reference: server.py:144-163,578-586).
 
+        ``cfg.resume`` restores through the checkpoint manifest (newest
+        valid entry, torn-file fallback, ``resume`` telemetry event with
+        exactly-once round accounting: the resumed run's round numbers
+        continue from the checkpoint instead of restarting at 1).
+        ``cfg.load_parameters`` keeps the legacy single-file reload.
+
         Multi-host: process 0's checkpoint bytes are broadcast so every
         process restores IDENTICAL state (host-local files may differ or
         be absent on workers), then re-replicated onto the DCN mesh."""
+        if self.cfg.resume:
+            state = self._load_resume_state()
+            if state is not None:
+                return state
+            return self.init_state()
         if self.cfg.load_parameters and self.multiprocess:
             path = ckpt.checkpoint_path(self.cfg)
             data = None
@@ -565,6 +682,7 @@ class Simulator:
             print_with_color(
                 f"Load state from checkpoint (process-0 broadcast): {path}",
                 "yellow")
+            self._state_donation_ok = False  # restored state: donation off
             return self._ensure_numerics_state(
                 replicate_to_mesh(host, self.mesh))
         state = self.init_state()
@@ -578,6 +696,7 @@ class Simulator:
                 if "numerics" in state:
                     loaded["numerics"] = state["numerics"]
                 state = loaded
+                self._state_donation_ok = False  # restored: donation off
                 print_with_color(f"Load state from checkpoint: {path}", "yellow")
             except FileNotFoundError:
                 pass
@@ -623,8 +742,16 @@ class Simulator:
             programs=programs,
             jax_version=jax.__version__,
             compile_cache_dir=self._compile_cache_dir or "",
+            fault_plan=[spec.describe() for spec in self.cfg.faults],
             config=dataclasses.asdict(self.cfg),
         )
+        if self._resume_info is not None:
+            # exactly-once round accounting: the resumed run declares the
+            # boundary it continues from (its own round events then start
+            # at round+1 — no round number is ever recorded twice within
+            # a run, and cross-run tooling can join on this event)
+            tel.events.emit("resume", **self._resume_info)
+            self._resume_info = None
 
     def _emit_attribution(self, metrics, global_params, stacked, sizes,
                           weights_mask, broadcast_number: int,
@@ -693,12 +820,30 @@ class Simulator:
         the background checkpoint writer (the final state is durably on
         disk before the call returns), then the counters snapshot,
         compile-cache stats, a run_end record, and the Chrome trace
-        file."""
+        file.
+
+        Runs on EVERY exit path — the run methods call it from a
+        ``finally`` block, so a crashing round still drains the async
+        checkpoint writer (the last durable checkpoint survives the
+        crash) and still leaves a closed, usable event record.  A drain
+        error is re-raised only after the telemetry record is written."""
         self._resolve_inflight_validations()
         if self._numerics_drainer is not None and state is not None:
             self._numerics_drainer.drain(state.get("numerics"))
+        drain_error: BaseException | None = None
         if self._ckpt_writer is not None:
-            self._ckpt_writer.drain()
+            try:
+                self._ckpt_writer.drain()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                drain_error = e
+        try:
+            self._emit_run_end(history, t_start)
+        finally:
+            if drain_error is not None:
+                raise drain_error
+
+    def _emit_run_end(self, history: list[dict[str, Any]],
+                      t_start: float) -> None:
         tel = self.telemetry
         if not tel.enabled:
             return
@@ -819,21 +964,45 @@ class Simulator:
     # one round
     # ------------------------------------------------------------------
 
+    def _on_writer_restart(self, restarts: int) -> None:
+        """The async-writer supervisor revived a dead thread: count it
+        and record the recovery (a dead writer used to silently stop
+        persisting until close() deadlocked)."""
+        self.telemetry.counters.inc("checkpoint_writer_restarts")
+        self.telemetry.events.emit("fault", fault="writer_death",
+                                   action="recovered", restarts=restarts)
+
+    def _note_round_faults(self, round_no: int, broadcast: int) -> None:
+        """Host-side bookkeeping once a round resolves: record the plan's
+        device-side injections for this broadcast (the injection itself
+        ran inside the jitted program) and fire any armed monitor stall."""
+        injector = self._fault_injector
+        if injector is None:
+            return
+        injector.note_round_resolved(broadcast)
+        injector.maybe_stall_monitor(round_no, self.monitor)
+
     def _save_checkpoint(self, state: dict[str, Any]) -> None:
         """Persist ``state`` (reference cadence: every successful round,
         server.py:549-553).  Multi-host: gather the DCN-sharded tree to
         host (one all-gather over DCN) and let process 0 alone write the
         file — every process participates in the gather collective.
 
-        With ``cfg.checkpoint_async`` the device->host gather stays here
-        (on the round loop) but serialization, the file write and the
-        fsync move to the background writer: submit is O(gather) and
-        rapid rounds coalesce to the newest state (last-write-wins).  The
-        synchronous path increments ``checkpoint_writes`` directly; the
-        async path counts submits here and completed writes from the
-        writer's callback."""
+        All writes flow through the :class:`CheckpointManager`: a
+        round-stamped durable entry + the legacy alias + the manifest
+        record, with bounded retry-with-backoff and fail-open on a dead
+        disk (the run outlives its persistence).  With
+        ``cfg.checkpoint_async`` the device->host gather stays here (on
+        the round loop) but serialization, the file write and the fsync
+        move to the supervised background writer: submit is O(gather)
+        and rapid rounds coalesce to the newest state (last-write-wins).
+        """
         path = ckpt.checkpoint_path(self.cfg)
         writer = self._ckpt_writer
+        round_no = int(state["completed_rounds"])
+        meta = {"round": round_no, "broadcast": int(state["broadcasts"])}
+        if self._fault_injector is not None:
+            self._fault_injector.maybe_kill_writer(round_no, writer)
         with self.telemetry.tracer.span("checkpoint", background=writer is not None):
             # the numerics ring is observability state, excluded from
             # checkpoints (resume compatibility across numerics on/off;
@@ -845,12 +1014,12 @@ class Simulator:
                 write_here = jax.process_index() == 0
             if write_here:
                 if writer is not None:
-                    writer.submit(path, ckpt.host_state(target))
+                    writer.submit(path, ckpt.host_state(target), meta=meta)
                     self.telemetry.counters.inc("checkpoint_submits")
                 else:
-                    ckpt.save_state(path, target)
-                    self.telemetry.counters.inc("checkpoint_writes")
-        self.telemetry.events.emit("checkpoint", path=path,
+                    self._ckpt_manager.write(path, ckpt.host_state(target),
+                                             meta)
+        self.telemetry.events.emit("checkpoint", path=path, round=round_no,
                                    background=writer is not None)
 
     def run_round(self, state: dict[str, Any]) -> tuple[dict[str, Any], dict[str, Any]]:
@@ -1358,8 +1527,9 @@ class Simulator:
 
         return body
 
-    def _fused_chunk(self, length: int) -> Callable:
-        fn = self._fused_cache.get(length)
+    def _fused_chunk(self, length: int, donate: bool = True) -> Callable:
+        key = (length, donate)
+        fn = self._fused_cache.get(key)
         if fn is None:
             self.telemetry.counters.inc("round_program_cache_misses")
             body = self._build_fused_body()
@@ -1368,13 +1538,14 @@ class Simulator:
                 return jax.lax.scan(body, state, None, length=length)
 
             fn = jax.jit(chunk,
-                         donate_argnums=self.donation_spec()["fused_chunk"])
-            self._fused_cache[length] = fn
+                         donate_argnums=(self.donation_spec()["fused_chunk"]
+                                         if donate else ()))
+            self._fused_cache[key] = fn
         else:
             self.telemetry.counters.inc("round_program_cache_hits")
         return fn
 
-    def _fused_executable(self, length: int, fn: Callable, state) -> Any:
+    def _fused_executable(self, key: tuple, fn: Callable, state) -> Any:
         """AOT-compile the fused chunk under a telemetry compile span
         (explicit compile-vs-dispatch split + guarded memory stats).
 
@@ -1382,8 +1553,9 @@ class Simulator:
         executables pin input shardings; the lazy jit path re-shards
         freely).  Returns the executable, or False when AOT failed — the
         caller then falls back to the jitted ``fn`` permanently."""
-        exe = self._fused_exe_cache.get(length)
+        exe = self._fused_exe_cache.get(key)
         if exe is None:
+            length = key[0]
             tel = self.telemetry
             label = f"fused_scan[{length}]"
             t0 = time.perf_counter()
@@ -1403,7 +1575,7 @@ class Simulator:
                 if memory:
                     event["memory_bytes"] = memory
                 tel.events.emit("compile", **event)
-            self._fused_exe_cache[length] = exe
+            self._fused_exe_cache[key] = exe
         return exe
 
     def _canonical_device_state(self, state: dict[str, Any]) -> dict[str, Any]:
@@ -1456,10 +1628,13 @@ class Simulator:
                 "state has inactive clients (resumed from a hyper-detection "
                 "run?); use run_round/run for active-mask-aware validation"
             )
-        fn = self._fused_chunk(num_broadcasts)
+        # restored-state runs keep donation off (see the donation
+        # safety latch in __init__)
+        donate = self._state_donation_ok
+        fn = self._fused_chunk(num_broadcasts, donate=donate)
         state = self._canonical_device_state(state)
         if self.telemetry.enabled and self.mesh is None:
-            exe = self._fused_executable(num_broadcasts, fn, state)
+            exe = self._fused_executable((num_broadcasts, donate), fn, state)
             if exe is not False:
                 return exe(state)
         return fn(state)
@@ -1495,99 +1670,110 @@ class Simulator:
         history: list[dict[str, Any]] = []
         consecutive_failures = 0  # run()'s retry counter semantics
         first_dispatch = True
+        # exactly-once round accounting: a resumed run's round numbers
+        # continue from the checkpoint instead of restarting at 1
+        round_offset = int(state["completed_rounds"])
         t_start = time.perf_counter()
 
         self._start_monitor()
-        while int(state["completed_rounds"]) < num_rounds:
-            remaining = num_rounds - int(state["completed_rounds"])
-            # Chunk sizing doubles as a compile-cache policy: the first
-            # dispatch compiles one bounded-length scan (a 100-round run
-            # must not compile a length-100 program — compile time grows
-            # with scan length), repeat full chunks hit the jit cache, and
-            # retry tails use length-1 scans (one extra compile total)
-            # instead of a fresh fused program per shrinking remainder.
-            cap = chunk_size if chunk_size else DEFAULT_SCAN_CHUNK
-            if chunk_size:
-                n = min(chunk_size, remaining)
-            elif first_dispatch or remaining >= cap:
-                n = min(cap, remaining)
-            else:
-                n = 1
-            first_dispatch = False
-            # compile happens on this chunk length's first dispatch —
-            # either AOT inside run_scan (telemetry on) or lazily at the
-            # jitted call (telemetry off); flag the chunk either way so
-            # the metrics CLI can split steady vs incl-compile rates
-            includes_compile = (n not in self._fused_cache
-                                and n not in self._fused_exe_cache)
-            done_before = int(state["completed_rounds"])
-            self._maybe_start_profile(done_before + 1, done_before + n)
-            t0 = time.perf_counter()
-            with tel.tracer.span("chunk", chunk_len=n):
-                state, metrics = self.run_scan(state, n)
-                # dispatch is ASYNC (CPU backend included): without
-                # blocking, `elapsed` measures enqueue time (~10 ms) while
-                # the actual rounds run inside the np.asarray sync below,
-                # making chunk_seconds fiction.  Block inside the timed
-                # section.
-                jax.block_until_ready(metrics)
-            elapsed = time.perf_counter() - t0
-            tel.events.emit("chunk", chunk_len=n, seconds=round(elapsed, 6),
-                            includes_compile=includes_compile)
-            host = {k: np.asarray(v) for k, v in metrics.items()}
-            # the scan stacked one numerics row per round — already host
-            # numpy via the per-chunk materialization above (no new sync)
-            numerics_rows = host.pop("numerics_row", None)
-            broadcasts_after = int(state["broadcasts"])
-            for i in range(n):
-                entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
-                         for k, v in host.items()}
-                # A fused chunk is ONE device dispatch: per-round wall time
-                # is not observable inside it, so report the genuine chunk
-                # measurement instead of a synthetic per-round average
-                # (run()'s per-entry "seconds" IS genuine, engine.py:286).
-                entry["chunk_seconds"] = elapsed
-                entry["chunk_len"] = n
-                entry["round"] = len(history) + 1  # attempt index
-                entry["broadcast"] = broadcasts_after - n + i + 1
-                if numerics_rows is not None:
-                    self._numerics_drainer.push_host_row(
-                        entry["round"], entry["broadcast"], numerics_rows[i])
-                history.append(entry)
-                tel.events.round_event(entry)
-                if self.monitor is not None:
-                    # heartbeat cadence: the chunk is one dispatch, so the
-                    # amortized per-round time feeds the stall median
-                    self.monitor.record_round(entry, duration=elapsed / n)
-                if entry["ok"]:
-                    consecutive_failures = 0
+        try:
+            while int(state["completed_rounds"]) < num_rounds:
+                remaining = num_rounds - int(state["completed_rounds"])
+                # Chunk sizing doubles as a compile-cache policy: the first
+                # dispatch compiles one bounded-length scan (a 100-round run
+                # must not compile a length-100 program — compile time grows
+                # with scan length), repeat full chunks hit the jit cache, and
+                # retry tails use length-1 scans (one extra compile total)
+                # instead of a fresh fused program per shrinking remainder.
+                cap = chunk_size if chunk_size else DEFAULT_SCAN_CHUNK
+                if chunk_size:
+                    n = min(chunk_size, remaining)
+                elif first_dispatch or remaining >= cap:
+                    n = min(cap, remaining)
                 else:
-                    consecutive_failures += 1
-                    tel.counters.inc("rounds_failed")
-            self._maybe_stop_profile(int(state["completed_rounds"]))
-            if consecutive_failures > MAX_ROUND_RETRIES:
-                self._finish_run(history, t_start, state)
-                raise RuntimeError(
-                    f"round failed {consecutive_failures} times in a row; "
-                    "aborting (the reference would retry forever, "
-                    "server.py:546-556)"
-                )
-            if progress is not None:
-                ok_so_far = sum(1 for h in history if h["ok"])
-                progress["ok_rounds"] = ok_so_far
-                progress["interim_rounds_per_sec_incl_compile"] = round(
-                    ok_so_far / (time.perf_counter() - t_start), 4)
-            if save_checkpoints:
-                self._save_checkpoint(state)
-            if verbose:
-                done = int(state["completed_rounds"])
-                last = history[-1]
-                keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in last]
-                msg = " ".join(f"{k}={last[k]:.4f}" for k in keys)
-                print_with_color(
-                    f"[fast] {done}/{num_rounds} rounds, chunk of {n} in "
-                    f"{elapsed:.2f}s ({elapsed / n:.3f}s/round) {msg}", "green")
-        self._finish_run(history, t_start, state)
+                    n = 1
+                first_dispatch = False
+                # compile happens on this chunk length's first dispatch —
+                # either AOT inside run_scan (telemetry on) or lazily at the
+                # jitted call (telemetry off); flag the chunk either way so
+                # the metrics CLI can split steady vs incl-compile rates
+                donate_key = (n, self._state_donation_ok)
+                includes_compile = (donate_key not in self._fused_cache
+                                    and donate_key not in self._fused_exe_cache)
+                done_before = int(state["completed_rounds"])
+                self._maybe_start_profile(done_before + 1, done_before + n)
+                t0 = time.perf_counter()
+                with tel.tracer.span("chunk", chunk_len=n):
+                    state, metrics = self.run_scan(state, n)
+                    # dispatch is ASYNC (CPU backend included): without
+                    # blocking, `elapsed` measures enqueue time (~10 ms) while
+                    # the actual rounds run inside the np.asarray sync below,
+                    # making chunk_seconds fiction.  Block inside the timed
+                    # section.
+                    jax.block_until_ready(metrics)
+                elapsed = time.perf_counter() - t0
+                tel.events.emit("chunk", chunk_len=n, seconds=round(elapsed, 6),
+                                includes_compile=includes_compile)
+                host = {k: np.asarray(v) for k, v in metrics.items()}
+                # the scan stacked one numerics row per round — already host
+                # numpy via the per-chunk materialization above (no new sync)
+                numerics_rows = host.pop("numerics_row", None)
+                broadcasts_after = int(state["broadcasts"])
+                for i in range(n):
+                    entry = {k: (bool(v[i]) if k == "ok" else float(v[i]))
+                             for k, v in host.items()}
+                    # A fused chunk is ONE device dispatch: per-round wall time
+                    # is not observable inside it, so report the genuine chunk
+                    # measurement instead of a synthetic per-round average
+                    # (run()'s per-entry "seconds" IS genuine, engine.py:286).
+                    entry["chunk_seconds"] = elapsed
+                    entry["chunk_len"] = n
+                    # attempt index, offset by the resume point
+                    entry["round"] = round_offset + len(history) + 1
+                    entry["broadcast"] = broadcasts_after - n + i + 1
+                    if numerics_rows is not None:
+                        self._numerics_drainer.push_host_row(
+                            entry["round"], entry["broadcast"],
+                            numerics_rows[i])
+                    history.append(entry)
+                    tel.events.round_event(entry)
+                    self._note_round_faults(entry["round"], entry["broadcast"])
+                    if self.monitor is not None:
+                        # heartbeat cadence: the chunk is one dispatch, so the
+                        # amortized per-round time feeds the stall median
+                        self.monitor.record_round(entry, duration=elapsed / n)
+                    if entry["ok"]:
+                        consecutive_failures = 0
+                    else:
+                        consecutive_failures += 1
+                        tel.counters.inc("rounds_failed")
+                self._maybe_stop_profile(int(state["completed_rounds"]))
+                if consecutive_failures > MAX_ROUND_RETRIES:
+                    raise RuntimeError(
+                        f"round failed {consecutive_failures} times in a row; "
+                        "aborting (the reference would retry forever, "
+                        "server.py:546-556)"
+                    )
+                if progress is not None:
+                    ok_so_far = sum(1 for h in history if h["ok"])
+                    progress["ok_rounds"] = ok_so_far
+                    progress["interim_rounds_per_sec_incl_compile"] = round(
+                        ok_so_far / (time.perf_counter() - t_start), 4)
+                if save_checkpoints:
+                    self._save_checkpoint(state)
+                if verbose:
+                    done = int(state["completed_rounds"])
+                    last = history[-1]
+                    keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in last]
+                    msg = " ".join(f"{k}={last[k]:.4f}" for k in keys)
+                    print_with_color(
+                        f"[fast] {done}/{num_rounds} rounds, chunk of {n} in "
+                        f"{elapsed:.2f}s ({elapsed / n:.3f}s/round) {msg}", "green")
+        finally:
+            # every exit path — including a crashing round — drains the
+            # async checkpoint writer (the last durable checkpoint
+            # survives) and closes the telemetry record
+            self._finish_run(history, t_start, state)
         return state, history
 
     # ------------------------------------------------------------------
@@ -1692,6 +1878,20 @@ class Simulator:
         run_fast); with checkpointing on the resolved round's state is
         gathered on this thread and handed to the async writer (or written
         synchronously without ``cfg.checkpoint_async``).
+
+        **Graceful degradation** (ISSUE 6): after
+        ``cfg.pipeline_demote_after`` consecutive device-side rollbacks
+        the executor DEMOTES to depth-0 — the same jitted step program,
+        but each round is resolved before the next one dispatches, so a
+        failure storm stops paying for wasted in-flight rounds and the
+        host sees every verdict immediately.  After
+        ``cfg.pipeline_repromote_after`` consecutive clean rounds it
+        re-promotes to depth-1.  Both transitions emit ``degrade`` events
+        and flip the live monitor's degraded flag (/healthz
+        ``status: degraded`` — distinct from both healthy and stalled).
+        Because demotion only changes WHEN the host resolves (never what
+        the device computes), final params stay bit-identical to the
+        never-demoted and fully-synchronous runs.
         """
         cfg = self.cfg
         tel = self.telemetry
@@ -1703,90 +1903,143 @@ class Simulator:
         completed = int(state["completed_rounds"])
         broadcast = int(state["broadcasts"])
         include_eval = self.validation is not None and not cfg.validation_async
-        donate = not save_checkpoints
+        # donation also stays off for restored-state runs (see the
+        # donation safety latch in __init__)
+        donate = not save_checkpoints and self._state_donation_ok
         step = self._pipeline_step_fn(include_eval, donate)
         pending: dict[str, Any] | None = None
         consecutive_failures = 0
+        degraded = False
+        clean_streak = 0
         last_resolve = time.perf_counter()
 
-        while completed < num_rounds or pending is not None:
-            new_pending: dict[str, Any] | None = None
-            if completed + (1 if pending is not None else 0) < num_rounds:
-                broadcast += 1
-                target_round = completed + (2 if pending is not None else 1)
-                self._maybe_start_profile(target_round)
-                with tel.tracer.span("dispatch", round=target_round,
-                                     broadcast=broadcast):
-                    if tel.enabled and self.mesh is None:
-                        exe = self._pipeline_executable(
-                            (include_eval, donate), step, state)
+        try:
+            while completed < num_rounds or pending is not None:
+                new_pending: dict[str, Any] | None = None
+                want_more = (completed + (1 if pending is not None else 0)
+                             < num_rounds)
+                # demoted: no overlap — never dispatch past an unresolved
+                # round (depth-0); healthy: depth-1 dispatch-then-resolve
+                if want_more and (pending is None or not degraded):
+                    broadcast += 1
+                    target_round = completed + (2 if pending is not None else 1)
+                    self._maybe_start_profile(target_round)
+                    with tel.tracer.span("dispatch", round=target_round,
+                                         broadcast=broadcast):
+                        if tel.enabled and self.mesh is None:
+                            exe = self._pipeline_executable(
+                                (include_eval, donate), step, state)
+                        else:
+                            exe = False
+                        new_state, metrics = (
+                            exe(state) if exe is not False else step(state))
+                    val = None
+                    if (self.validation is not None and cfg.validation_async
+                            and broadcast % cfg.validation_every == 0):
+                        if self.is_hyper:
+                            gen_params, _ = self.generate_all(
+                                new_state["hnet_params"])
+                            val = self.validation.test_hyper_async(gen_params)
+                        else:
+                            val = self.validation.test_async(
+                                new_state["global_params"])
+                    new_pending = {
+                        "metrics": metrics,
+                        "broadcast": broadcast,
+                        "val": val,
+                        # kept ONLY for checkpointing; with donation on, round
+                        # N+1's dispatch consumes these buffers
+                        "state": new_state if save_checkpoints else None,
+                    }
+                    state = new_state
+                if degraded and pending is None and new_pending is not None:
+                    # depth-0: resolve the just-dispatched round immediately
+                    pending, new_pending = new_pending, None
+                if pending is not None:
+                    round_no = completed + 1
+                    with tel.tracer.span("resolve", round=round_no):
+                        entry = self._resolve_pipeline_round(pending, round_no)
+                    now = time.perf_counter()
+                    entry["seconds"] = now - last_resolve
+                    last_resolve = now
+                    if degraded:
+                        entry["degraded"] = True
+                    history.append(entry)
+                    tel.events.round_event(entry)
+                    self._note_round_faults(round_no, pending["broadcast"])
+                    if self.monitor is not None:
+                        self.monitor.record_round(entry)
+                    if entry["ok"]:
+                        completed += 1
+                        consecutive_failures = 0
+                        if save_checkpoints:
+                            self._save_checkpoint(pending["state"])
+                        if degraded:
+                            clean_streak += 1
+                            if clean_streak >= cfg.pipeline_repromote_after:
+                                degraded = False
+                                clean_streak = 0
+                                tel.counters.inc("executor_repromotions")
+                                tel.events.emit(
+                                    "degrade", state="repromoted",
+                                    round=round_no,
+                                    clean_rounds=cfg.pipeline_repromote_after)
+                                if self.monitor is not None:
+                                    self.monitor.set_degraded(None)
+                                print_with_color(
+                                    f"[pipeline] re-promoted to depth-1 "
+                                    f"after {cfg.pipeline_repromote_after} "
+                                    "clean rounds", "cyan")
+                        if verbose:
+                            keys = [k for k in ("roc_auc", "accuracy", "nll",
+                                                "train_loss")
+                                    if k in entry and entry[k] == entry[k]]
+                            msg = " ".join(f"{k}={entry[k]:.4f}" for k in keys)
+                            print_with_color(
+                                f"[pipeline] round {round_no} resolved in "
+                                f"{entry['seconds']:.2f}s {msg}", "green")
                     else:
-                        exe = False
-                    new_state, metrics = (
-                        exe(state) if exe is not False else step(state))
-                val = None
-                if (self.validation is not None and cfg.validation_async
-                        and broadcast % cfg.validation_every == 0):
-                    if self.is_hyper:
-                        gen_params, _ = self.generate_all(
-                            new_state["hnet_params"])
-                        val = self.validation.test_hyper_async(gen_params)
-                    else:
-                        val = self.validation.test_async(
-                            new_state["global_params"])
-                new_pending = {
-                    "metrics": metrics,
-                    "broadcast": broadcast,
-                    "val": val,
-                    # kept ONLY for checkpointing; with donation on, round
-                    # N+1's dispatch consumes these buffers
-                    "state": new_state if save_checkpoints else None,
-                }
-                state = new_state
-            if pending is not None:
-                round_no = completed + 1
-                with tel.tracer.span("resolve", round=round_no):
-                    entry = self._resolve_pipeline_round(pending, round_no)
-                now = time.perf_counter()
-                entry["seconds"] = now - last_resolve
-                last_resolve = now
-                history.append(entry)
-                tel.events.round_event(entry)
-                if self.monitor is not None:
-                    self.monitor.record_round(entry)
-                if entry["ok"]:
-                    completed += 1
-                    consecutive_failures = 0
-                    if save_checkpoints:
-                        self._save_checkpoint(pending["state"])
-                    if verbose:
-                        keys = [k for k in ("roc_auc", "accuracy", "nll",
-                                            "train_loss")
-                                if k in entry and entry[k] == entry[k]]
-                        msg = " ".join(f"{k}={entry[k]:.4f}" for k in keys)
-                        print_with_color(
-                            f"[pipeline] round {round_no} resolved in "
-                            f"{entry['seconds']:.2f}s {msg}", "green")
-                else:
-                    consecutive_failures += 1
-                    tel.counters.inc("rounds_failed")
-                    tel.counters.inc("rounds_retried")
-                    tel.events.emit("retry", round=round_no,
-                                    retries=consecutive_failures)
-                    print_with_color("Training failed!", "yellow")
-                    self.logger.log_warning(
-                        f"Round {round_no} failed "
-                        f"(retry {consecutive_failures})")
-                    if consecutive_failures > MAX_ROUND_RETRIES:
-                        self._finish_run(history, t_start, state)
-                        raise RuntimeError(
+                        consecutive_failures += 1
+                        clean_streak = 0
+                        tel.counters.inc("rounds_failed")
+                        tel.counters.inc("rounds_retried")
+                        tel.events.emit("retry", round=round_no,
+                                        retries=consecutive_failures)
+                        print_with_color("Training failed!", "yellow")
+                        self.logger.log_warning(
                             f"Round {round_no} failed "
-                            f"{consecutive_failures} times; aborting (the "
-                            "reference would retry forever, "
-                            "server.py:546-556)")
-                self._maybe_stop_profile(completed)
-            pending = new_pending
-        self._finish_run(history, t_start, state)
+                            f"(retry {consecutive_failures})")
+                        if (not degraded and consecutive_failures
+                                >= cfg.pipeline_demote_after):
+                            degraded = True
+                            clean_streak = 0
+                            info = {
+                                "round": round_no,
+                                "consecutive_failures": consecutive_failures,
+                            }
+                            tel.counters.inc("executor_demotions")
+                            tel.events.emit("degrade", state="demoted", **info)
+                            if self.monitor is not None:
+                                self.monitor.set_degraded(info)
+                            print_with_color(
+                                f"[pipeline] {consecutive_failures} "
+                                "consecutive rollbacks — demoting to "
+                                "synchronous (depth-0) resolution", "yellow")
+                        if consecutive_failures > MAX_ROUND_RETRIES:
+                            raise RuntimeError(
+                                f"Round {round_no} failed "
+                                f"{consecutive_failures} times; aborting (the "
+                                "reference would retry forever, "
+                                "server.py:546-556)")
+                    self._maybe_stop_profile(completed)
+                pending = new_pending
+        finally:
+            if self.monitor is not None and degraded:
+                self.monitor.set_degraded(None)
+            # drains the async writer + closes the telemetry record on
+            # exception paths too (satellite: the last durable checkpoint
+            # must survive a crashing round)
+            self._finish_run(history, t_start, state)
         return state, history
 
     # ------------------------------------------------------------------
@@ -1830,42 +2083,46 @@ class Simulator:
         self.logger.log_info("### Application start ###")
 
         self._start_monitor()
-        while int(state["completed_rounds"]) < num_rounds:
-            round_no = int(state["completed_rounds"]) + 1
-            if verbose:
-                print_with_color(f"Start training round {round_no}", "yellow")
-            self._maybe_start_profile(round_no)
-            state, metrics = self.run_round(state)
-            history.append(metrics)
-            if self.monitor is not None:
-                self.monitor.record_round(metrics)
-            self._maybe_stop_profile(int(state["completed_rounds"]))
-            if metrics["ok"]:
-                retries = 0
-                if save_checkpoints:
-                    self._save_checkpoint(state)
+        try:
+            while int(state["completed_rounds"]) < num_rounds:
+                round_no = int(state["completed_rounds"]) + 1
                 if verbose:
-                    keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in metrics]
-                    msg = " ".join(f"{k}={metrics[k]:.4f}" for k in keys)
-                    phases = metrics.get("phases") or {}
-                    if phases:
-                        msg += " [" + ", ".join(
-                            f"{k}={v * 1e3:.0f}ms" for k, v in phases.items()) + "]"
-                    print_with_color(
-                        f"Round {round_no} done in {metrics['seconds']:.2f}s {msg}", "green")
-            else:
-                retries += 1
-                self.telemetry.counters.inc("rounds_failed")
-                self.telemetry.counters.inc("rounds_retried")
-                self.telemetry.events.emit("retry", round=round_no,
-                                           retries=retries)
-                print_with_color("Training failed!", "yellow")
-                self.logger.log_warning(f"Round {round_no} failed (retry {retries})")
-                if retries > MAX_ROUND_RETRIES:
-                    self._finish_run(history, t_start, state)
-                    raise RuntimeError(
-                        f"Round {round_no} failed {retries} times; aborting "
-                        "(the reference would retry forever, server.py:546-556)"
-                    )
-        self._finish_run(history, t_start, state)
+                    print_with_color(f"Start training round {round_no}", "yellow")
+                self._maybe_start_profile(round_no)
+                state, metrics = self.run_round(state)
+                history.append(metrics)
+                self._note_round_faults(round_no, metrics["broadcast"])
+                if self.monitor is not None:
+                    self.monitor.record_round(metrics)
+                self._maybe_stop_profile(int(state["completed_rounds"]))
+                if metrics["ok"]:
+                    retries = 0
+                    if save_checkpoints:
+                        self._save_checkpoint(state)
+                    if verbose:
+                        keys = [k for k in ("roc_auc", "accuracy", "nll", "train_loss") if k in metrics]
+                        msg = " ".join(f"{k}={metrics[k]:.4f}" for k in keys)
+                        phases = metrics.get("phases") or {}
+                        if phases:
+                            msg += " [" + ", ".join(
+                                f"{k}={v * 1e3:.0f}ms" for k, v in phases.items()) + "]"
+                        print_with_color(
+                            f"Round {round_no} done in {metrics['seconds']:.2f}s {msg}", "green")
+                else:
+                    retries += 1
+                    self.telemetry.counters.inc("rounds_failed")
+                    self.telemetry.counters.inc("rounds_retried")
+                    self.telemetry.events.emit("retry", round=round_no,
+                                               retries=retries)
+                    print_with_color("Training failed!", "yellow")
+                    self.logger.log_warning(f"Round {round_no} failed (retry {retries})")
+                    if retries > MAX_ROUND_RETRIES:
+                        raise RuntimeError(
+                            f"Round {round_no} failed {retries} times; aborting "
+                            "(the reference would retry forever, server.py:546-556)"
+                        )
+        finally:
+            # every exit path — including a crashing round — drains the
+            # async checkpoint writer and closes the telemetry record
+            self._finish_run(history, t_start, state)
         return state, history

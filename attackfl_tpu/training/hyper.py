@@ -27,6 +27,7 @@ import optax
 
 from attackfl_tpu.config import Config
 from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indices
+from attackfl_tpu.faults.inject import apply_nan_storm, build_client_fault_fn
 from attackfl_tpu.ops import attacks
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.training.local import build_local_update, resolve_compute_dtype
@@ -91,6 +92,9 @@ def build_hyper_round(
         )
 
     drop_rate = cfg.client_dropout_rate
+    # plan-driven deterministic faults (ISSUE 6) — see training/round.py
+    forced_drop_fn = build_client_fault_fn(cfg.faults, num_clients, "dropout")
+    nan_storm_fn = build_client_fault_fn(cfg.faults, num_clients, "nan_storm")
 
     def round_step(hnet_params, prev_genuine, have_genuine, active_mask, rng, broadcast_number):
         broadcast_params, _emb = generate_all(hnet_params)
@@ -108,6 +112,11 @@ def build_hyper_round(
             sizes, mask, kept = apply_client_dropout(k_drop, sizes, mask, drop_rate)
         else:
             kept = jnp.ones((num_clients,), bool)
+        if forced_drop_fn is not None:
+            # scheduled straggler cohort at a chosen broadcast (ISSUE 6)
+            kept = kept & ~forced_drop_fn(broadcast_number)
+            sizes = sizes * kept
+            mask = mask & kept[:, None]
         idx, mask = constrain(idx), constrain(mask)
         train_keys = constrain(jax.random.split(k_train, num_clients))
         stacked, ok, losses = jax.vmap(local_update, in_axes=(0, 0, 0, 0))(
@@ -167,6 +176,11 @@ def build_hyper_round(
 
             stacked = jax.tree.map(scatter, stacked, attacked)
             ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
+
+        if nan_storm_fn is not None:
+            # after the attack scatter; rides the ok-flag path (ISSUE 6)
+            stacked, ok = apply_nan_storm(
+                nan_storm_fn(broadcast_number), stacked, ok)
 
         ok = jnp.all(ok | ~active_mask.astype(bool))
         participating = active_mask * kept.astype(active_mask.dtype)

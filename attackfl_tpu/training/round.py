@@ -33,6 +33,7 @@ import numpy as np
 
 from attackfl_tpu.config import Config
 from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indices
+from attackfl_tpu.faults.inject import apply_nan_storm, build_client_fault_fn
 from attackfl_tpu.ops import aggregators, attacks
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.training.local import (
@@ -233,6 +234,12 @@ def build_round_step(
     constrain = constrain or (lambda tree: tree)
 
     drop_rate = cfg.client_dropout_rate
+    # plan-driven deterministic faults, compiled into the program (ISSUE
+    # 6): a forced-dropout cohort mask and a NaN storm keyed on the
+    # broadcast clock — None without a plan, so fault-free programs carry
+    # zero injection ops
+    forced_drop_fn = build_client_fault_fn(cfg.faults, num_clients, "dropout")
+    nan_storm_fn = build_client_fault_fn(cfg.faults, num_clients, "nan_storm")
 
     def round_step(global_params, prev_genuine, have_genuine, rng, broadcast_number):
         if drop_rate > 0:
@@ -246,6 +253,12 @@ def build_round_step(
             sizes, mask, kept = apply_client_dropout(k_drop, sizes, mask, drop_rate)
         else:
             kept = jnp.ones((num_clients,), bool)
+        if forced_drop_fn is not None:
+            # scheduled straggler cohort: exactly the probabilistic-dropout
+            # semantics (size 0, all batches masked), at a chosen round
+            kept = kept & ~forced_drop_fn(broadcast_number)
+            sizes = sizes * kept
+            mask = mask & kept[:, None]
         idx, mask = constrain(idx), constrain(mask)
         train_keys = constrain(jax.random.split(k_train, num_clients))
         stacked, ok, losses = batched_update(global_params, train_keys, idx, mask)
@@ -279,6 +292,14 @@ def build_round_step(
             stacked = jax.tree.map(scatter, stacked, attacked)
             # attackers that attacked did not train; their NaN status resets
             ok = ok.at[grp_arr].set(jnp.where(active_rows, True, ok[grp_arr]))
+
+        if nan_storm_fn is not None:
+            # injected AFTER the attack scatter so a stormed attacker row
+            # is stormed too: the failure rides the existing ok-flag path
+            # (train_ok below fails the round, the leak-pool select keeps
+            # the previous pool, the executor retries/rolls back)
+            stacked, ok = apply_nan_storm(
+                nan_storm_fn(broadcast_number), stacked, ok)
 
         # a round where every client drops has no updates at all — fail it
         # (the reference analog is a barrier deadlock, server.py:271-272)
@@ -317,6 +338,8 @@ def build_round_step(
         "leak_k": leak_k,
         "attack_groups": len(attack_groups),
         "dropout_rate": drop_rate,
+        "device_faults": sum(1 for s in cfg.faults
+                             if s.kind in ("nan_storm", "dropout")),
     }
     return round_step
 

@@ -1,4 +1,4 @@
-"""Checkpoint/resume.
+"""Checkpoint/resume: durable manifest-tracked state persistence.
 
 The reference torch.saves the global state_dict to ``{model}.pth`` (or
 ``{model}_hyper_{N}.pth``) after every successful round and reloads at
@@ -7,18 +7,57 @@ simulation state — global/hyper params, optimizer state, round index, rng
 key and attack clock — serialized with flax msgpack to
 ``{model}.msgpack`` / ``{model}_hyper_{N}.msgpack``.  Restoring requires a
 structurally matching template (same config), like torch load_state_dict.
+
+ISSUE 6 adds the durability layer around that contract:
+
+* :class:`CheckpointManager` — every checkpoint is written as a
+  round-stamped entry file (``{stem}.r<round>.msgpack``) plus the legacy
+  alias, recorded in an atomically-published ``manifest.json`` carrying
+  the round, broadcast, content hash (sha256), byte length, config
+  fingerprint and telemetry run_id, with last-``keep`` retention.  Writes
+  retry with exponential backoff (emitting the schema'd ``retry`` event)
+  and FAIL OPEN after the budget: a dying disk degrades persistence, it
+  does not kill training — the previous durable entry remains.
+* torn-file detection — :meth:`CheckpointManager.load_latest` verifies
+  each entry's length + hash against the manifest and falls back to the
+  previous good entry on mismatch (a torn/truncated file from a killed
+  write is detected, never deserialized into garbage).
+* a supervisor inside :class:`AsyncCheckpointWriter` — a dead writer
+  thread (crash-injected or real) is restarted on the next
+  submit/drain/close, with the pending snapshot preserved.
+* :func:`sweep_orphans` — ``*.msgpack.tmp*`` / ``manifest.json.tmp*``
+  leftovers from killed writes are removed at Simulator startup and after
+  write errors (``_write_bytes`` also unlinks its own temp on failure).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import os
 import threading
+import time
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+# Config fields that never change the checkpointed state's structure or
+# trajectory: excluded from the fingerprint so e.g. re-pointing log dirs
+# or turning the pipeline on does not refuse a legitimate resume.
+_FINGERPRINT_VOLATILE = frozenset({
+    "log_path", "checkpoint_dir", "compile_cache_dir", "telemetry",
+    "num_round", "load_parameters", "resume", "faults", "checkpoint_async",
+    "checkpoint_keep", "pipeline", "pipeline_demote_after",
+    "pipeline_repromote_after", "validation_every", "validation_async",
+    "reload_parameters_per_round",
+})
 
 
 def _is_key(x: Any) -> bool:
@@ -39,21 +78,318 @@ def host_state(state: Any) -> Any:
 
 
 def _write_bytes(path: str, data: bytes, tmp_suffix: str = ".tmp") -> None:
-    """Durable atomic publish: write a temp file, fsync it, rename."""
+    """Durable atomic publish: write a temp file, fsync it, rename.  A
+    failure mid-write unlinks its own temp so crashes can't accumulate
+    orphans (the startup :func:`sweep_orphans` catches hard kills)."""
     tmp = path + tmp_suffix
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def save_state(path: str, state: Any) -> None:
     _write_bytes(path, serialization.to_bytes(host_state(state)))
 
 
+def content_hash(data: bytes) -> str:
+    """The manifest's content-hash contract (hex sha256)."""
+    return hashlib.sha256(data).hexdigest()
+
+
+def config_fingerprint(cfg: Any) -> str:
+    """Stable short hash of the state-structure-relevant config fields.
+
+    Recorded in the manifest and compared at resume: a mismatch means the
+    checkpoint was written under a different experiment (model, mode,
+    client count, prng_impl, ...) — surfaced as a loud warning, while
+    volatile knobs (paths, telemetry, executor choice) are excluded so
+    they never block a legitimate resume."""
+    raw = dataclasses.asdict(cfg)
+    for field in _FINGERPRINT_VOLATILE:
+        raw.pop(field, None)
+    blob = json.dumps(raw, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def sweep_orphans(directory: str) -> list[str]:
+    """Remove orphaned checkpoint/manifest temp files (``*.msgpack.tmp*``
+    / ``manifest.json.tmp*``) left by killed or failed writes.  Only the
+    checkpoint layer's own temp patterns are touched — the checkpoint dir
+    defaults to the working directory, so a broad ``*.tmp`` glob could
+    eat user files.  Returns the removed paths."""
+    removed: list[str] = []
+    try:
+        names = os.listdir(directory or ".")
+    except OSError:
+        return removed
+    for name in names:
+        if ".msgpack.tmp" not in name and not name.startswith(
+                MANIFEST_NAME + ".tmp"):
+            continue
+        path = os.path.join(directory or ".", name)
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        removed.append(path)
+    return removed
+
+
+@dataclasses.dataclass
+class LoadResult:
+    """Outcome of :meth:`CheckpointManager.load_latest`: the restored
+    state (None when no entry survived verification), the manifest entry
+    it came from, every rejected ``(entry, reason)`` newer than it, and
+    the manifest itself."""
+
+    state: Any
+    entry: dict[str, Any] | None
+    rejected: list[tuple[dict[str, Any], str]]
+    manifest: dict[str, Any] | None
+
+
+class CheckpointManager:
+    """Durable checkpoints around the legacy single-file contract.
+
+    Each write lands as a round-stamped entry file next to the legacy
+    ``{model}.msgpack`` alias (published as a hardlink of the entry —
+    one data write, two names), then the manifest is atomically replaced
+    recording ``{round, broadcast, file, sha256, bytes, ts}`` with
+    last-``keep`` retention (older entry files are deleted; the alias
+    keeps its own directory entry).  ``fresh=True`` (a non-resuming run)
+    discards a pre-existing manifest's entries — they describe a
+    different trajectory and must not be fallback candidates.
+
+    Write attempts retry ``retries`` times with exponential backoff
+    (base ``backoff`` seconds), emitting one ``retry`` event per failed
+    attempt; after the budget the write FAILS OPEN (``checkpoint`` event
+    with the error + ``checkpoint_write_failures`` counter) so training
+    outlives a dying disk.  ``injector`` is the fault-injection seam
+    (:class:`~attackfl_tpu.faults.inject.HostFaultInjector`).
+
+    Thread-safety: one manager instance is driven either by the round
+    loop (synchronous saves) or by the single async writer thread, never
+    both concurrently for writes; the internal lock still serializes
+    manifest mutations against concurrent ``load_latest`` calls.
+    """
+
+    def __init__(self, path: str, *, fingerprint: str = "",
+                 run_id: str = "", keep: int = 3, retries: int = 3,
+                 backoff: float = 0.05, telemetry=None, injector=None,
+                 fresh: bool = True):
+        self.path = path
+        self.directory = os.path.dirname(path) or "."
+        stem = os.path.basename(path)
+        self.stem = stem[:-len(".msgpack")] if stem.endswith(".msgpack") else stem
+        self.fingerprint = fingerprint
+        self.run_id = run_id
+        self.keep = max(int(keep), 1)
+        self.retries = max(int(retries), 0)
+        self.backoff = float(backoff)
+        self._tel = telemetry
+        self._injector = injector
+        self._lock = threading.Lock()
+        self._entries: list[dict[str, Any]] | None = None
+        self._fresh = fresh
+
+    # ---- manifest ----------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, MANIFEST_NAME)
+
+    def read_manifest(self) -> dict[str, Any] | None:
+        """The on-disk manifest, or None when absent/corrupt (a corrupt
+        manifest is treated like a missing one — the legacy alias file is
+        still a valid resume source)."""
+        try:
+            with open(self.manifest_path) as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+        return manifest if isinstance(manifest, dict) else None
+
+    def _load_entries(self) -> list[dict[str, Any]]:
+        if self._entries is not None:
+            return self._entries
+        manifest = None if self._fresh else self.read_manifest()
+        entries = list((manifest or {}).get("entries", []))
+        # only this base's entries: one directory may hold several models
+        self._entries = [e for e in entries
+                         if isinstance(e, dict)
+                         and str(e.get("file", "")).startswith(self.stem + ".")]
+        return self._entries
+
+    def _entry_file(self, round_no: int) -> str:
+        return f"{self.stem}.r{round_no:08d}.msgpack"
+
+    def _publish_manifest(self) -> None:
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "base": os.path.basename(self.path),
+            "fingerprint": self.fingerprint,
+            "run_id": self.run_id,
+            "updated": round(time.time(), 6),
+            "entries": self._entries or [],
+        }
+        _write_bytes(self.manifest_path,
+                     (json.dumps(manifest, indent=1) + "\n").encode())
+
+    # ---- write path --------------------------------------------------
+
+    def write(self, path: str, state: Any, meta: dict[str, Any] | None = None
+              ) -> bool:
+        """Serialize + durably publish one checkpoint (the async writer's
+        ``write_fn``; the synchronous save calls it directly).  ``state``
+        is a host tree (see :func:`host_state`).  Returns True when the
+        state is durably on disk, False on the fail-open path."""
+        return self.write_bytes(serialization.to_bytes(state), meta or {})
+
+    def write_bytes(self, data: bytes, meta: dict[str, Any]) -> bool:
+        round_no = int(meta.get("round", 0))
+        entry_name = self._entry_file(round_no)
+        entry_path = os.path.join(self.directory, entry_name)
+        delay = self.backoff
+        for attempt in range(1, self.retries + 2):
+            try:
+                if self._injector is not None:
+                    self._injector.on_checkpoint_write(round_no)
+                _write_bytes(entry_path, data)
+                break
+            except OSError as e:
+                if attempt > self.retries:
+                    # fail open: persistence degrades, training survives
+                    if self._tel is not None:
+                        self._tel.counters.inc("checkpoint_write_failures")
+                        self._tel.events.emit(
+                            "checkpoint", path=entry_path, round=round_no,
+                            durable=False,
+                            error=f"{type(e).__name__}: {e}"[:300])
+                    sweep_orphans(self.directory)
+                    return False
+                if self._tel is not None:
+                    self._tel.counters.inc("checkpoint_write_retries")
+                    self._tel.events.emit(
+                        "retry", round=round_no, retries=attempt,
+                        reason="checkpoint_write",
+                        error=f"{type(e).__name__}: {e}"[:300],
+                        backoff_seconds=round(delay, 6))
+                time.sleep(delay)
+                delay *= 2
+        self._publish_alias(entry_path, data)
+        self._record_entry(round_no, entry_name, data, meta)
+        if self._injector is not None:
+            # torn-file injection tears the entry AFTER it was durably
+            # recorded — the manifest keeps the honest hash, which is
+            # exactly what load-time verification checks against
+            self._injector.after_checkpoint_write(round_no, entry_path)
+        if self._tel is not None:
+            self._tel.counters.inc("checkpoint_writes")
+        return True
+
+    def _publish_alias(self, entry_path: str, data: bytes) -> None:
+        """Point the legacy ``{model}.msgpack`` name at the new entry —
+        a hardlink when the filesystem allows (one data write, two
+        names), a second atomic write otherwise."""
+        tmp = self.path + ".alias.msgpack.tmp"
+        try:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            os.link(entry_path, tmp)
+            os.replace(tmp, self.path)
+        except OSError:
+            _write_bytes(self.path, data)
+
+    def _record_entry(self, round_no: int, entry_name: str, data: bytes,
+                      meta: dict[str, Any]) -> None:
+        with self._lock:
+            entries = self._load_entries()
+            # entries at/after this round are stale (a resume re-ran them)
+            entries = [e for e in entries if int(e.get("round", 0)) < round_no]
+            entries.append({
+                "round": round_no,
+                "broadcast": int(meta.get("broadcast", round_no)),
+                "file": entry_name,
+                "sha256": content_hash(data),
+                "bytes": len(data),
+                "ts": round(time.time(), 6),
+            })
+            dropped, entries = entries[:-self.keep], entries[-self.keep:]
+            self._entries = entries
+            self._publish_manifest()
+        for old in dropped:
+            try:
+                os.unlink(os.path.join(self.directory, str(old["file"])))
+            except OSError:
+                pass
+
+    # ---- load path ---------------------------------------------------
+
+    def load_latest(self, template: Any) -> LoadResult:
+        """Restore the newest VALID manifest entry.
+
+        Entries are tried newest-first; each must match its recorded byte
+        length and sha256 (torn/truncated detection) and deserialize
+        against ``template``.  Rejected entries are returned with their
+        reasons so the caller can emit them into telemetry.  With no
+        manifest at all, the legacy alias file is the single candidate
+        (resume keeps working on directories from older versions)."""
+        manifest = self.read_manifest()
+        rejected: list[tuple[dict[str, Any], str]] = []
+        entries = [e for e in (manifest or {}).get("entries", [])
+                   if isinstance(e, dict)
+                   and str(e.get("file", "")).startswith(self.stem + ".")]
+        for entry in reversed(entries):
+            path = os.path.join(self.directory, str(entry.get("file", "")))
+            try:
+                with open(path, "rb") as fh:
+                    data = fh.read()
+            except OSError as e:
+                rejected.append((entry, f"unreadable: {e}"))
+                continue
+            if len(data) != int(entry.get("bytes", -1)):
+                rejected.append((entry, (
+                    f"torn/truncated: {len(data)} bytes on disk vs "
+                    f"{entry.get('bytes')} recorded")))
+                continue
+            if content_hash(data) != entry.get("sha256"):
+                rejected.append((entry, "content hash mismatch"))
+                continue
+            try:
+                state = load_state_bytes(data, template, path)
+            except ValueError as e:
+                rejected.append((entry, f"structure mismatch: {e}"))
+                continue
+            return LoadResult(state, entry, rejected, manifest)
+        if manifest is None and os.path.exists(self.path):
+            try:
+                state = load_state(self.path, template)
+            except (OSError, ValueError) as e:
+                rejected.append((
+                    {"file": os.path.basename(self.path)},
+                    f"legacy checkpoint unreadable: {e}"))
+            else:
+                return LoadResult(
+                    state, {"file": os.path.basename(self.path),
+                            "round": None, "legacy": True},
+                    rejected, None)
+        return LoadResult(None, None, rejected, manifest)
+
+
 class AsyncCheckpointWriter:
-    """Background checkpoint persistence with last-write-wins coalescing.
+    """Background checkpoint persistence with last-write-wins coalescing
+    and a thread supervisor.
 
     The round loop calls :meth:`submit` with an already-gathered host tree
     (see :func:`host_state`); msgpack serialization, the file write and the
@@ -65,36 +401,85 @@ class AsyncCheckpointWriter:
     disk; :meth:`close` drains and stops the thread, guaranteeing the
     final submitted state is flushed.  A write error is re-raised on the
     next submit/drain/close so a dying disk can't fail silently.
+
+    ``write_fn(path, state, meta)`` replaces the default
+    serialize-and-write (the engine passes
+    :meth:`CheckpointManager.write`, which handles its own retries and
+    fails open).  A DEAD writer thread — crash-injected through
+    :meth:`inject_thread_death` or a real bug — no longer wedges the run:
+    every entry point re-supervises via ``_ensure_thread``, restarting
+    the thread with the pending snapshot intact and invoking
+    ``on_restart(restart_count)``.
     """
 
-    def __init__(self, on_write: Callable[[str], None] | None = None):
+    def __init__(self, on_write: Callable[[str], None] | None = None,
+                 write_fn: Callable[[str, Any, dict], Any] | None = None,
+                 on_restart: Callable[[int], None] | None = None):
         self._cond = threading.Condition()
-        self._pending: tuple[str, Any] | None = None
+        self._pending: tuple[str, Any, dict] | None = None
         self._writing = False
         self._closed = False
+        self._crash = False
         self._error: BaseException | None = None
         self._on_write = on_write
+        self._on_restart = on_restart
+        self._write_fn = write_fn
         self.writes_completed = 0
         self.writes_coalesced = 0
-        self._thread = threading.Thread(
+        self.restarts = 0
+        self._thread = self._spawn_thread()
+
+    def _spawn_thread(self) -> threading.Thread:
+        thread = threading.Thread(
             target=self._loop, name="attackfl-ckpt-writer", daemon=True)
-        self._thread.start()
+        thread.start()
+        return thread
+
+    def _ensure_thread(self) -> None:
+        """The supervisor: restart a dead (non-closed) writer thread.
+        Caller holds the condition lock.  The pending snapshot survives —
+        the restarted thread picks it up immediately."""
+        if self._closed or self._thread.is_alive():
+            return
+        self.restarts += 1
+        self._writing = False  # a dead thread can't clear its own flag
+        self._crash = False
+        self._thread = self._spawn_thread()
+        if self._on_restart is not None:
+            self._on_restart(self.restarts)
+
+    def inject_thread_death(self) -> None:
+        """Fault injection: the writer thread exits as if it crashed
+        (pending work stays queued; the supervisor revives it on the next
+        submit/drain/close)."""
+        with self._cond:
+            self._crash = True
+            self._cond.notify_all()
+
+    def _write(self, path: str, state: Any, meta: dict) -> None:
+        if self._write_fn is not None:
+            self._write_fn(path, state, meta)
+            return
+        # distinct temp suffix: a concurrent synchronous
+        # save_state to the same path must not clobber our temp
+        _write_bytes(path, serialization.to_bytes(state),
+                     tmp_suffix=f".msgpack.tmp.async{id(self):x}")
 
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while self._pending is None and not self._closed:
+                while (self._pending is None and not self._closed
+                       and not self._crash):
                     self._cond.wait()
+                if self._crash:
+                    return  # injected death — supervisor will restart
                 if self._pending is None and self._closed:
                     return
-                path, state = self._pending
+                path, state, meta = self._pending
                 self._pending = None
                 self._writing = True
             try:
-                # distinct temp suffix: a concurrent synchronous
-                # save_state to the same path must not clobber our temp
-                _write_bytes(path, serialization.to_bytes(state),
-                             tmp_suffix=f".tmp.async{id(self):x}")
+                self._write(path, state, meta)
             except BaseException as e:  # noqa: BLE001 — surfaced on next call
                 with self._cond:
                     self._error = e
@@ -113,23 +498,28 @@ class AsyncCheckpointWriter:
             error, self._error = self._error, None
             raise RuntimeError("async checkpoint write failed") from error
 
-    def submit(self, path: str, state: Any) -> None:
+    def submit(self, path: str, state: Any,
+               meta: dict[str, Any] | None = None) -> None:
         """Queue ``state`` (a host tree from :func:`host_state`) for
-        persistence to ``path``.  Returns immediately."""
+        persistence to ``path``.  Returns immediately.  ``meta`` rides to
+        the ``write_fn`` (the manager's round/broadcast stamp)."""
         with self._cond:
             self._check_error()
             if self._closed:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
+            self._ensure_thread()
             if self._pending is not None:
                 self.writes_coalesced += 1
-            self._pending = (path, state)
+            self._pending = (path, state, dict(meta or {}))
             self._cond.notify_all()
 
     def drain(self) -> None:
         """Block until every submitted state is durably written."""
         with self._cond:
+            self._ensure_thread()
             while self._pending is not None or self._writing:
                 self._cond.wait()
+                self._ensure_thread()  # died mid-drain? revive, don't hang
             self._check_error()
 
     def close(self) -> None:
@@ -137,6 +527,7 @@ class AsyncCheckpointWriter:
         with self._cond:
             if self._closed and not self._thread.is_alive():
                 return
+            self._ensure_thread()
             self._closed = True
             self._cond.notify_all()
         self._thread.join()

@@ -1,0 +1,570 @@
+"""Fault-tolerant run lifecycle (ISSUE 6): the chaos suite.
+
+Deterministic fault injection (NaN storms, forced dropout, checkpoint
+write errors, torn files, writer-thread death, monitor stalls), durable
+manifest checkpoints with torn-file fallback, kill-and-resume parity
+across all three executors, graceful pipelined-executor degradation, and
+the schema-v4 event corpus.
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.faults.plan import (
+    FaultSpec, faults_from_config, parse_fault_plan,
+)
+from attackfl_tpu.training.engine import Simulator
+from attackfl_tpu.utils import checkpoint as ckpt
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128, total_clients=3,
+    validation=False,
+)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(BASE)
+    base.update(kw)
+    return Config(log_path=str(tmp_path), checkpoint_dir=str(tmp_path), **base)
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(ckpt.host_state(a)), jax.tree.leaves(ckpt.host_state(b))
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _events(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# plan parsing
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parsing_roundtrip():
+    plan = parse_fault_plan(
+        "nan_storm@3:clients=0,1;ckpt_write_error@2:count=2;writer_death@4;"
+        "monitor_stall@5;dropout@6:clients=2;ckpt_torn@7")
+    assert [s.kind for s in plan] == [
+        "nan_storm", "ckpt_write_error", "writer_death", "monitor_stall",
+        "dropout", "ckpt_torn"]
+    assert plan[0].clients == (0, 1) and plan[0].round == 3
+    assert plan[1].count == 2
+    # YAML form builds the identical specs
+    yaml_plan = faults_from_config([
+        {"kind": "nan_storm", "round": 3, "clients": [0, 1]},
+        {"kind": "ckpt_write_error", "round": 2, "count": 2},
+    ])
+    assert yaml_plan == plan[:2]
+
+
+def test_fault_plan_rejects_garbage():
+    with pytest.raises(ValueError, match="Unknown fault kind"):
+        parse_fault_plan("nan_bomb@3")
+    with pytest.raises(ValueError, match="kind@round"):
+        parse_fault_plan("nan_storm")
+    with pytest.raises(ValueError, match="unknown option"):
+        parse_fault_plan("nan_storm@3:sigma=2")
+    with pytest.raises(ValueError, match="no client cohort"):
+        FaultSpec(kind="writer_death", round=2, clients=(0,))
+    with pytest.raises(ValueError, match="out of range"):
+        Config(faults=(FaultSpec(kind="nan_storm", round=1, clients=(99,)),),
+               **BASE)
+
+
+# ---------------------------------------------------------------------------
+# device-side injection: NaN storms + forced dropout
+# ---------------------------------------------------------------------------
+
+def test_nan_storm_fails_round_and_recovers(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path, num_round=3,
+               faults=parse_fault_plan("nan_storm@2:clients=0"))
+    sim = Simulator(cfg)
+    state, hist = sim.run(verbose=False)
+    sim.close()
+    # broadcast 2 storms -> round 2's first attempt fails, retry succeeds
+    flags = [(h["broadcast"], h["ok"]) for h in hist]
+    assert (2, False) in flags
+    assert int(state["completed_rounds"]) == 3
+    assert sim.telemetry.counters.get("nan_train_rounds") == 1
+    events = _events(tmp_path / "events.jsonl")
+    faults = [e for e in events if e["kind"] == "fault"]
+    assert [(f["fault"], f["round"]) for f in faults] == [("nan_storm", 2)]
+    assert faults[0]["device_side"] is True and faults[0]["clients"] == [0]
+
+
+def test_nan_storm_parity_across_executors(tmp_path):
+    """The same fault plan produces bit-identical final params on the
+    synchronous, pipelined and fused executors (the storm is compiled
+    into the shared round program; recovery is the shared accept path)."""
+    plan = parse_fault_plan("nan_storm@2:clients=1")
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+
+    def run_sync():
+        cfg = _cfg(tmp_path / "sync", num_round=3, faults=plan, **tel)
+        sim = Simulator(cfg)
+        state, hist = sim.run(save_checkpoints=False, verbose=False)
+        return state, hist
+
+    def run_pipe():
+        cfg = _cfg(tmp_path / "pipe", num_round=3, faults=plan,
+                   pipeline=True, **tel)
+        sim = Simulator(cfg)
+        state, hist = sim.run(save_checkpoints=False, verbose=False)
+        return state, hist
+
+    def run_fused():
+        cfg = _cfg(tmp_path / "fused", num_round=3, faults=plan, **tel)
+        sim = Simulator(cfg)
+        state, hist = sim.run_fast(save_checkpoints=False, verbose=False)
+        return state, hist
+
+    (s_sync, h_sync), (s_pipe, h_pipe), (s_fused, h_fused) = (
+        run_sync(), run_pipe(), run_fused())
+    assert _leaves_equal({"p": s_sync["global_params"]},
+                         {"p": s_pipe["global_params"]})
+    assert _leaves_equal({"p": s_sync["global_params"]},
+                         {"p": s_fused["global_params"]})
+    # all three observed the same ok sequence on the broadcast clock
+    ok_by_broadcast = lambda h: [(e["broadcast"], bool(e["ok"])) for e in h]  # noqa: E731
+    assert ok_by_broadcast(h_sync) == ok_by_broadcast(h_pipe) \
+        == ok_by_broadcast(h_fused)
+
+
+def test_forced_dropout_cohort(tmp_path):
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    # one client dropped at broadcast 2: the round still completes (the
+    # others report) but takes a different trajectory than fault-free
+    cfg = _cfg(tmp_path / "a", num_round=2,
+               faults=parse_fault_plan("dropout@2:clients=0"), **tel)
+    sim = Simulator(cfg)
+    state, hist = sim.run(save_checkpoints=False, verbose=False)
+    assert all(h["ok"] for h in hist)
+    cfg_ref = _cfg(tmp_path / "b", num_round=2, **tel)
+    ref_state, _ = Simulator(cfg_ref).run(save_checkpoints=False, verbose=False)
+    assert not _leaves_equal({"p": state["global_params"]},
+                             {"p": ref_state["global_params"]})
+
+    # the whole cohort dropped: the round fails (no reporters) and retries
+    cfg_all = _cfg(tmp_path / "c", num_round=2,
+                   faults=parse_fault_plan("dropout@2"), **tel)
+    _, hist_all = Simulator(cfg_all).run(save_checkpoints=False, verbose=False)
+    assert (2, False) in [(h["broadcast"], h["ok"]) for h in hist_all]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability: retries, fail-open, torn files, manifest
+# ---------------------------------------------------------------------------
+
+def test_ckpt_write_error_retries_then_succeeds(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path, num_round=2,
+               faults=parse_fault_plan("ckpt_write_error@1:count=2"))
+    sim = Simulator(cfg)
+    sim._ckpt_manager.backoff = 0.001  # keep the test fast
+    state, hist = sim.run(verbose=False)
+    sim.close()
+    assert all(h["ok"] for h in hist)
+    assert sim.telemetry.counters.get("checkpoint_write_retries") == 2
+    assert sim.telemetry.counters.get("checkpoint_write_failures") == 0
+    events = _events(tmp_path / "events.jsonl")
+    retry_reasons = [e.get("reason") for e in events if e["kind"] == "retry"]
+    assert retry_reasons.count("checkpoint_write") == 2
+    # the retried write still landed durably and loads
+    loaded = ckpt.load_state(ckpt.checkpoint_path(cfg), sim.init_state())
+    assert int(loaded["completed_rounds"]) == 2
+
+
+def test_ckpt_write_error_fails_open_after_budget(tmp_path, monkeypatch):
+    """A disk that keeps failing degrades persistence, not training: the
+    run completes, the failure is counted + evented, and the previous
+    durable entry remains the resume point."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path, num_round=2,
+               faults=parse_fault_plan("ckpt_write_error@2:count=10"))
+    sim = Simulator(cfg)
+    sim._ckpt_manager.backoff = 0.001
+    state, hist = sim.run(verbose=False)
+    sim.close()
+    assert int(state["completed_rounds"]) == 2
+    assert sim.telemetry.counters.get("checkpoint_write_failures") == 1
+    manifest = json.load(open(tmp_path / "manifest.json"))
+    assert [e["round"] for e in manifest["entries"]] == [1]
+    failed = [e for e in _events(tmp_path / "events.jsonl")
+              if e["kind"] == "checkpoint" and e.get("durable") is False]
+    assert failed and "injected" in failed[0]["error"]
+
+
+def test_manifest_records_and_retention(tmp_path):
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    cfg = _cfg(tmp_path, num_round=5, checkpoint_keep=2, **tel)
+    sim = Simulator(cfg)
+    sim.run(verbose=False)
+    manifest = json.load(open(tmp_path / "manifest.json"))
+    assert manifest["version"] == 1
+    assert manifest["fingerprint"] == ckpt.config_fingerprint(cfg)
+    assert [e["round"] for e in manifest["entries"]] == [4, 5]
+    for entry in manifest["entries"]:
+        path = tmp_path / entry["file"]
+        data = path.read_bytes()
+        assert len(data) == entry["bytes"]
+        assert ckpt.content_hash(data) == entry["sha256"]
+    # retention deleted the older entry files; the legacy alias holds the
+    # newest state byte-for-byte
+    files = {p.name for p in tmp_path.glob("*.msgpack")}
+    assert files == {"CNNModel.msgpack", "CNNModel.r00000004.msgpack",
+                     "CNNModel.r00000005.msgpack"}
+    assert (tmp_path / "CNNModel.msgpack").read_bytes() == \
+        (tmp_path / "CNNModel.r00000005.msgpack").read_bytes()
+
+
+def test_committed_torn_corpus_fallback():
+    """The committed corpus (tests/data/ckpt_corpus): the newest entry is
+    torn (truncated to half its recorded bytes) — load must reject it
+    with a torn/truncated reason and fall back to the previous entry."""
+    template = {"step": np.asarray(0, np.int32),
+                "w": np.zeros(4, np.float32)}
+    mgr = ckpt.CheckpointManager(
+        str(REPO / "tests" / "data" / "ckpt_corpus" / "state.msgpack"),
+        fresh=False)
+    result = mgr.load_latest(template)
+    assert result.entry is not None and result.entry["round"] == 2
+    assert int(result.state["step"]) == 2
+    np.testing.assert_allclose(
+        np.asarray(result.state["w"]),
+        np.linspace(0.0, 1.0, 4, dtype=np.float32) * 2)
+    assert len(result.rejected) == 1
+    rejected_entry, reason = result.rejected[0]
+    assert rejected_entry["round"] == 3 and "torn/truncated" in reason
+
+
+def test_all_entries_torn_returns_none(tmp_path):
+    corpus = REPO / "tests" / "data" / "ckpt_corpus"
+    work = tmp_path / "corpus"
+    shutil.copytree(corpus, work)
+    for name in ("state.r00000001.msgpack", "state.r00000002.msgpack"):
+        with open(work / name, "r+b") as fh:
+            fh.truncate(5)
+    template = {"step": np.asarray(0, np.int32), "w": np.zeros(4, np.float32)}
+    result = ckpt.CheckpointManager(
+        str(work / "state.msgpack"), fresh=False).load_latest(template)
+    assert result.state is None and result.entry is None
+    assert len(result.rejected) == 3
+
+
+def test_orphan_tmp_sweep(tmp_path):
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    (tmp_path / "CNNModel.msgpack.tmp").write_bytes(b"junk")
+    (tmp_path / "CNNModel.msgpack.msgpack.tmp.asyncdeadbeef").write_bytes(b"junk")
+    (tmp_path / "manifest.json.tmp").write_bytes(b"junk")
+    (tmp_path / "keep_me.tmp").write_bytes(b"user file")  # not ours
+    cfg = _cfg(tmp_path, num_round=1, **tel)
+    sim = Simulator(cfg)
+    assert sim.telemetry.counters.get("orphan_tmp_swept") == 3
+    assert not (tmp_path / "CNNModel.msgpack.tmp").exists()
+    assert not (tmp_path / "manifest.json.tmp").exists()
+    assert (tmp_path / "keep_me.tmp").exists()
+
+
+def test_write_bytes_unlinks_tmp_on_failure(tmp_path, monkeypatch):
+    path = tmp_path / "state.msgpack"
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("injected rename failure")
+
+    monkeypatch.setattr(ckpt.os, "replace", boom)
+    with pytest.raises(OSError, match="injected rename"):
+        ckpt._write_bytes(str(path), b"payload")
+    monkeypatch.setattr(ckpt.os, "replace", real_replace)
+    assert list(tmp_path.iterdir()) == []  # no orphaned temp left behind
+
+
+# ---------------------------------------------------------------------------
+# async-writer thread death + supervisor
+# ---------------------------------------------------------------------------
+
+def test_writer_death_supervisor_restarts(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path, num_round=3, checkpoint_async=True,
+               faults=parse_fault_plan("writer_death@1"))
+    sim = Simulator(cfg)
+    state, hist = sim.run(verbose=False)
+    writer = sim._ckpt_writer
+    sim.close()
+    assert writer.restarts >= 1
+    assert sim.telemetry.counters.get("checkpoint_writer_restarts") >= 1
+    # the final state is durably on disk despite the mid-run death
+    loaded = ckpt.load_state(ckpt.checkpoint_path(cfg), sim.init_state())
+    assert int(loaded["completed_rounds"]) == 3
+    faults = [e for e in _events(tmp_path / "events.jsonl")
+              if e["kind"] == "fault" and e["fault"] == "writer_death"]
+    assert {f["action"] for f in faults} == {"injected", "recovered"}
+
+
+def test_writer_death_direct_drain_revives():
+    """drain() on a writer whose thread died must revive it and flush the
+    pending snapshot, not hang forever."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        writer = ckpt.AsyncCheckpointWriter()
+        writer.inject_thread_death()
+        writer._thread.join(timeout=5)
+        assert not writer._thread.is_alive()
+        path = os.path.join(d, "state.msgpack")
+        writer.submit(path, {"step": np.asarray(3)})
+        writer.drain()
+        assert writer.restarts == 1
+        from flax import serialization
+
+        with open(path, "rb") as fh:
+            loaded = serialization.from_bytes({"step": np.asarray(0)}, fh.read())
+        assert int(loaded["step"]) == 3
+        writer.close()
+
+
+# ---------------------------------------------------------------------------
+# monitor: injected stall + degraded health state
+# ---------------------------------------------------------------------------
+
+def test_monitor_stall_injection(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = _cfg(tmp_path, num_round=2,
+               faults=parse_fault_plan("monitor_stall@1"))
+    cfg = cfg.replace(telemetry=dataclasses.replace(
+        cfg.telemetry, monitor=True, monitor_port=0))
+    sim = Simulator(cfg)
+    sim.run(verbose=False)
+    sim.close()
+    assert sim.telemetry.counters.get("stalls_detected") >= 1
+    kinds = [e["kind"] for e in _events(tmp_path / "events.jsonl")]
+    assert "stall" in kinds and "fault" in kinds
+
+
+def test_monitor_degraded_health_state(tmp_path):
+    """degraded != stalled != healthy on /healthz and /metrics."""
+    from attackfl_tpu.telemetry import Telemetry
+    from attackfl_tpu.telemetry.monitor import RunMonitor
+
+    monitor = RunMonitor(Telemetry.disabled())
+    monitor.run_started()
+    code, payload = monitor.health()
+    assert code == 200 and payload["status"] == "ok"
+    monitor.set_degraded({"round": 4, "consecutive_failures": 3})
+    code, payload = monitor.health()
+    assert code == 200 and payload["status"] == "degraded"
+    assert payload["consecutive_failures"] == 3
+    assert "attackfl_degraded 1" in monitor.metrics_text()
+    monitor.set_degraded(None)
+    code, payload = monitor.health()
+    assert payload["status"] == "ok"
+    assert "attackfl_degraded 0" in monitor.metrics_text()
+    # stalled wins over degraded (no progress at all beats slow progress)
+    monitor.set_degraded({"round": 4})
+    monitor.simulate_hang()
+    code, payload = monitor.health()
+    assert code == 503 and payload["status"] == "stalled"
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: demote after k rollbacks, re-promote after m clean
+# ---------------------------------------------------------------------------
+
+def test_pipeline_demotes_and_repromotes(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    # three consecutive stormed broadcasts -> 3 rollbacks -> demote; two
+    # clean rounds later -> re-promote
+    plan = parse_fault_plan("nan_storm@2;nan_storm@3;nan_storm@4")
+    cfg = _cfg(tmp_path, num_round=4, pipeline=True,
+               pipeline_demote_after=3, pipeline_repromote_after=2,
+               faults=plan)
+    sim = Simulator(cfg)
+    state, hist = sim.run(verbose=False)
+    sim.close()
+    assert int(state["completed_rounds"]) == 4
+    events = _events(tmp_path / "events.jsonl")
+    transitions = [(e["state"], e["round"]) for e in events
+                   if e["kind"] == "degrade"]
+    assert transitions == [("demoted", 2), ("repromoted", 3)]
+    assert sim.telemetry.counters.get("executor_demotions") == 1
+    assert sim.telemetry.counters.get("executor_repromotions") == 1
+    # rounds resolved while demoted are flagged
+    assert any(h.get("degraded") for h in hist)
+
+
+def test_degraded_run_params_bit_identical(tmp_path):
+    """Demotion only changes WHEN the host resolves — final params match
+    the synchronous executor under the identical fault plan."""
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    plan = parse_fault_plan("nan_storm@2;nan_storm@3;nan_storm@4")
+    cfg_pipe = _cfg(tmp_path / "pipe", num_round=4, pipeline=True,
+                    pipeline_demote_after=2, pipeline_repromote_after=2,
+                    faults=plan, **tel)
+    s_pipe, _ = Simulator(cfg_pipe).run(save_checkpoints=False, verbose=False)
+    cfg_sync = _cfg(tmp_path / "sync", num_round=4, faults=plan, **tel)
+    s_sync, _ = Simulator(cfg_sync).run(save_checkpoints=False, verbose=False)
+    assert _leaves_equal({"p": s_pipe["global_params"]},
+                         {"p": s_sync["global_params"]})
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume chaos: bit-identical continuation on all three executors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("executor", ["sync", "pipelined", "fused"])
+def test_kill_and_resume_bit_identical(tmp_path, monkeypatch, executor):
+    """Run 2 of 4 rounds, die (torn final checkpoint + orphaned temp),
+    ``--resume``, finish — final params bit-identical to an uninterrupted
+    run.  The torn entry forces the manifest fallback path: the resumed
+    run restores round 1 and re-runs rounds 2-4 on the same rng
+    trajectory."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path / "tel"))
+    (tmp_path / "tel").mkdir()
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+
+    def run(cfg, sim, rounds):
+        if executor == "sync":
+            return sim.run(num_rounds=rounds, verbose=False)
+        if executor == "pipelined":
+            return sim.run(num_rounds=rounds, verbose=False, pipeline=True)
+        return sim.run_fast(num_rounds=rounds, chunk_size=1, verbose=False)
+
+    # uninterrupted reference
+    cfg_ref = _cfg(tmp_path / "ref", num_round=4, **tel)
+    ref_state, _ = run(cfg_ref, Simulator(cfg_ref), 4)
+
+    # interrupted run: 2 rounds, then simulated death
+    work = tmp_path / "work"
+    cfg_a = _cfg(work, num_round=4, **tel)
+    run(cfg_a, Simulator(cfg_a), 2)
+    with open(work / "CNNModel.r00000002.msgpack", "r+b") as fh:
+        fh.truncate(64)  # death mid-write: torn newest entry
+    (work / "CNNModel.msgpack.tmp").write_bytes(b"junk")  # orphaned temp
+
+    cfg_b = _cfg(work, num_round=4, resume=True, **tel)
+    sim_b = Simulator(cfg_b)
+    res_state, hist = run(cfg_b, sim_b, 4)
+    # fell back to round 1 and re-ran 2..4 with continued numbering
+    assert [h["round"] for h in hist] == [2, 3, 4]
+    assert _leaves_equal(ref_state, res_state)
+
+
+def test_resume_event_and_summary(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    tel_off = {"telemetry": dataclasses.replace(Config().telemetry,
+                                                enabled=False)}
+    cfg_a = _cfg(tmp_path, num_round=2, **tel_off)
+    Simulator(cfg_a).run(verbose=False)
+
+    cfg_b = _cfg(tmp_path, num_round=4, resume=True)
+    sim = Simulator(cfg_b)
+    state, hist = sim.run(verbose=False)
+    sim.close()
+    events = _events(tmp_path / "events.jsonl")
+    resume = [e for e in events if e["kind"] == "resume"]
+    assert len(resume) == 1 and resume[0]["round"] == 2
+    # exactly-once accounting: the resumed run's round numbers continue
+    rounds = [e["round"] for e in events if e["kind"] == "round"]
+    assert rounds == [3, 4]
+    from attackfl_tpu.telemetry.summary import format_summary, summarize
+
+    summary = summarize(events)
+    assert summary["resumed_from"]["round"] == 2
+    assert "resumed: from round 2" in format_summary(summary)
+
+
+def test_resume_fresh_when_nothing_valid(tmp_path):
+    tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    cfg = _cfg(tmp_path / "empty", num_round=1, resume=True, **tel)
+    (tmp_path / "empty").mkdir()
+    sim = Simulator(cfg)
+    state, hist = sim.run(verbose=False)
+    assert int(state["completed_rounds"]) == 1  # started fresh, loudly
+
+
+# ---------------------------------------------------------------------------
+# crash paths: _finish_run drains on exceptions (satellite)
+# ---------------------------------------------------------------------------
+
+def test_finish_run_drains_writer_on_abort(tmp_path, monkeypatch):
+    """A run that ABORTS (retry budget exhausted) must still drain the
+    async writer — the last durable checkpoint survives the crash — and
+    still close the telemetry record (run_end present)."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    import attackfl_tpu.training.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "MAX_ROUND_RETRIES", 2)
+    # storm every broadcast after the first: round 2 can never complete
+    plan = parse_fault_plan(";".join(f"nan_storm@{b}" for b in range(2, 9)))
+    cfg = _cfg(tmp_path, num_round=3, checkpoint_async=True, faults=plan)
+    sim = Simulator(cfg)
+    with pytest.raises(RuntimeError, match="aborting"):
+        sim.run(verbose=False)
+    # drained: round 1's checkpoint is durable, not stuck in the queue
+    loaded = ckpt.load_state(ckpt.checkpoint_path(cfg), sim.init_state())
+    assert int(loaded["completed_rounds"]) == 1
+    kinds = [e["kind"] for e in _events(tmp_path / "events.jsonl")]
+    assert "run_end" in kinds
+    sim.close()
+
+
+# ---------------------------------------------------------------------------
+# schema v4 + audit integration
+# ---------------------------------------------------------------------------
+
+def test_v4_corpus_validates_and_exercises_new_kinds():
+    from attackfl_tpu.telemetry.events import validate_event
+
+    path = REPO / "tests" / "data" / "events.v4.jsonl"
+    events = [json.loads(line) for line in path.open()]
+    assert all(validate_event(e) == [] for e in events)
+    kinds = {e["kind"] for e in events}
+    assert {"fault", "degrade", "resume"} <= kinds
+    actions = {e["action"] for e in events if e["kind"] == "fault"}
+    assert actions == {"injected", "recovered"}
+    states = {e["state"] for e in events if e["kind"] == "degrade"}
+    assert states == {"demoted", "repromoted"}
+
+
+def test_v4_kinds_registered_and_older_schemas_unchanged():
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
+    )
+
+    assert SCHEMA_VERSION == 4
+    assert KINDS_BY_VERSION[4] == frozenset({"fault", "degrade", "resume"})
+    # v3 tooling semantics preserved: the new kinds are invisible at v3
+    assert not ({"fault", "degrade", "resume"} & known_kinds(3))
+    assert {"fault", "degrade", "resume"} <= known_kinds(4)
+
+
+def test_faulted_round_program_stays_sync_free():
+    """The injected program is held to the same invariants as the clean
+    one: the jaxpr/HLO auditor finds zero callback/transfer primitives in
+    a round program carrying a full device-side fault schedule."""
+    from attackfl_tpu.analysis.program_audit import audit_simulator
+    from attackfl_tpu.config import audit_config
+
+    cfg = audit_config(faults=parse_fault_plan(
+        "nan_storm@2:clients=0;dropout@3:clients=1"))
+    sim = Simulator(cfg)
+    reports = audit_simulator(sim)
+    assert reports, "auditor produced no program reports"
+    for report in reports:
+        assert report.ok, f"{report.name}: {report.to_dict()}"
