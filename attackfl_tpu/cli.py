@@ -20,7 +20,10 @@ section instead.
 * ``attackfl-tpu metrics <dir>`` — summarize a run's ``events.jsonl``
   (``--merge`` for cross-host skew, ``--forensics`` for defense TPR/FPR);
 * ``attackfl-tpu watch`` — poll a live run's monitor endpoint
-  (``--monitor`` on run/server) and print each new round as it lands.
+  (``--monitor`` on run/server) and print each new round as it lands;
+* ``attackfl-tpu ledger`` — the persistent cross-run store:
+  list/show/compare records, ``regress`` = the CI gate, ``import`` =
+  backfill committed BENCH_*.json artifacts.
 """
 
 from __future__ import annotations
@@ -395,6 +398,17 @@ def audit_main(argv=None) -> int:
     return _audit_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def ledger_main(argv=None) -> int:
+    """``attackfl-tpu ledger``: the persistent cross-run store —
+    ``list``/``show`` query it, ``compare`` diffs two runs (or a run
+    against its rolling baseline), ``regress`` is the CI gate (exit 1 on
+    a perf/quality regression), ``import`` backfills committed
+    ``BENCH_*.json`` artifacts.  Jax-free, like ``metrics``."""
+    from attackfl_tpu.ledger.cli import main as _ledger_main
+
+    return _ledger_main(list(sys.argv[1:] if argv is None else argv))
+
+
 _SUBCOMMANDS = {
     "run": run_main,
     "server": server_main,
@@ -402,6 +416,7 @@ _SUBCOMMANDS = {
     "metrics": metrics_main,
     "watch": watch_main,
     "audit": audit_main,
+    "ledger": ledger_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -416,6 +431,9 @@ commands:
   watch    poll a live run's monitor endpoint (/last-round, /healthz)
   audit    static analysis: AST rules + event-schema artifacts + jaxpr/HLO
            program invariants (--json for the machine-readable report)
+  ledger   persistent cross-run store: list/show records, compare two runs
+           (perf + numerics + forensics columns), regress = CI gate with
+           noise-aware thresholds, import = backfill BENCH_*.json
 """
 
 

@@ -110,6 +110,13 @@ class TelemetryConfig:
     schema-v3 ``metric`` events — sync-free on the fused/pipelined paths,
     one batched transfer per window on the synchronous path.  Metrics
     never touch the params math (bit-identical global params on vs off).
+
+    ``ledger`` (ISSUE 7, default on) appends one distilled record per run
+    to the persistent cross-run ledger (attackfl_tpu/ledger) at
+    ``ledger_dir`` (default ``<telemetry base>/ledger``; the
+    ``ATTACKFL_LEDGER_DIR`` env var overrides both) — pure event-log
+    post-processing at ``_finish_run``, zero new host syncs, queryable
+    with ``attackfl-tpu ledger list|show|compare|regress``.
     """
 
     enabled: bool = True
@@ -123,6 +130,8 @@ class TelemetryConfig:
     profile_rounds: str = ""
     numerics: bool = False
     numerics_window: int = 16
+    ledger: bool = True
+    ledger_dir: str = ""
 
     def __post_init__(self):
         if self.sample_every < 1:
@@ -599,6 +608,8 @@ def config_from_dict(raw: dict) -> Config:
             profile_rounds=str(_get(tele, "profile-rounds", "")),
             numerics=bool(_get(tele, "numerics", False)),
             numerics_window=int(_get(tele, "numerics-window", 16)),
+            ledger=bool(_get(tele, "ledger", True)),
+            ledger_dir=str(_get(tele, "ledger-dir", "")),
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),

@@ -1,0 +1,23 @@
+"""Cross-run ledger: the persistent, machine-readable run record store.
+
+PRs 1-6 made a *single run* observable (events.jsonl, live monitor,
+in-graph numerics, forensics); this package makes the *sequence of runs*
+observable.  Every run's ``_finish_run`` distills its event log into one
+schema-versioned ledger record (:mod:`~attackfl_tpu.ledger.record`) and
+appends it to a persistent JSONL ledger with an atomically-published
+index (:mod:`~attackfl_tpu.ledger.store`).  ``attackfl-tpu ledger
+list|show|compare|regress|import`` (:mod:`~attackfl_tpu.ledger.cli`)
+turns that store into queries, diffs and a CI-gateable regression check
+(:mod:`~attackfl_tpu.ledger.compare`).
+
+Everything here is pure event-log post-processing — jax-free, zero new
+host syncs, and never on the round loop's critical path.
+"""
+
+from attackfl_tpu.ledger.record import (  # noqa: F401
+    LEDGER_SCHEMA_VERSION, derive_record, records_from_bench,
+    validate_record,
+)
+from attackfl_tpu.ledger.store import (  # noqa: F401
+    ENV_LEDGER_DIR, LedgerStore, resolve_ledger_dir,
+)

@@ -1,0 +1,368 @@
+"""``attackfl-tpu ledger``: query the cross-run store, diff runs, gate CI.
+
+Subcommands (all jax-free — they read JSON and print; safe on any box
+that merely holds the artifacts):
+
+* ``list`` — the store's index as a table (or ``--json``);
+* ``show ID`` — one full record (id prefixes resolve when unambiguous);
+* ``compare A [B]`` — column diff of two records; with one id, A is
+  diffed against its rolling baseline (median of its fingerprint+executor
+  peers);
+* ``regress [ID]`` — the CI gate: noise-aware thresholds over perf,
+  quality, forensics and numerics columns; exit 0 = pass, 1 = regression,
+  2 = nothing to compare.  Default candidate: the newest record;
+  default baseline: its rolling baseline (``--against ID`` pins one);
+* ``import FILE...`` — backfill committed bench artifacts
+  (``BENCH_*.json`` metric lines or driver wrappers) into the store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+from typing import Any
+
+from attackfl_tpu.ledger.compare import (
+    compare_records, regress_check, rolling_baseline,
+)
+from attackfl_tpu.ledger.record import records_from_bench, validate_record
+from attackfl_tpu.ledger.store import LedgerStore, resolve_ledger_dir
+
+
+def _fmt_ts(ts: Any) -> str:
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        return "-"
+    return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M")
+
+
+def _fmt(value: Any, nd: int = 4) -> str:
+    if isinstance(value, bool):
+        return str(value)
+    if isinstance(value, (int, float)):
+        return f"{value:.{nd}g}" if isinstance(value, float) else str(value)
+    return "-" if value is None else str(value)
+
+
+def format_list(entries: list[dict[str, Any]]) -> str:
+    lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'src':<7}"
+             f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"]
+    for entry in entries:
+        workload = "-"
+        if entry.get("model") or entry.get("mode"):
+            workload = (f"{entry.get('model') or '?'}/"
+                        f"{entry.get('mode') or '?'}"
+                        f" c{entry.get('total_clients') or '?'}")
+        rounds = entry.get("rounds")
+        ok = entry.get("ok_rounds")
+        rounds_text = (f"{ok}/{rounds}" if isinstance(rounds, int)
+                       and isinstance(ok, int) and rounds else "-")
+        lines.append(
+            f"{str(entry.get('record_id') or '?')[:21]:<22}"
+            f"{_fmt_ts(entry.get('ts')):<18}"
+            f"{str(entry.get('executor') or '-'):<11}"
+            f"{str(entry.get('source') or '-'):<7}"
+            f"{workload[:27]:<28}"
+            f"{rounds_text:>7}"
+            f"{_fmt(entry.get('rounds_per_sec_steady')):>11}")
+    return "\n".join(lines)
+
+
+def format_record(record: dict[str, Any]) -> str:
+    lines = [f"record {record.get('record_id')} "
+             f"[{record.get('source')}/{record.get('executor')}"
+             + ("/resumed" if record.get("resumed") else "") + "]"]
+    lines.append(
+        f"  run_id={record.get('run_id') or '-'} "
+        f"fingerprint={record.get('fingerprint') or '-'} "
+        f"git={record.get('git_rev') or '-'}")
+    lines.append(
+        f"  jax={record.get('jax_version') or '-'}"
+        f"/{record.get('jaxlib_version') or '-'} "
+        f"backend={record.get('backend') or '-'} "
+        f"platform={record.get('platform') or '-'}")
+    if record.get("model") or record.get("mode"):
+        lines.append(
+            f"  workload: {record.get('model')}/{record.get('data_name')} "
+            f"mode={record.get('mode')} clients={record.get('total_clients')}")
+    lines.append(
+        f"  rounds: {record.get('ok_rounds')}/{record.get('rounds')} ok "
+        f"in {_fmt(record.get('wall_seconds'))}s, "
+        f"steady={_fmt(record.get('rounds_per_sec_steady'))} r/s, "
+        f"incl-compile={_fmt(record.get('rounds_per_sec_incl_compile'))} r/s")
+    attribution = record.get("time_attribution") or {}
+    if attribution:
+        lines.append(
+            "  time: device={} host={} validate={} ckpt={} "
+            "ckpt-overlap={} compile={} defense={} (of wall {})".format(
+                *(_fmt(attribution.get(k)) for k in (
+                    "device_compute_s", "host_resolution_s", "validation_s",
+                    "checkpoint_s", "checkpoint_overlapped_s", "compile_s",
+                    "defense_host_s", "wall_s"))))
+    if record.get("round_device_time") is not None:
+        lines.append(
+            f"  per-round: device={_fmt(record.get('round_device_time'))}s "
+            f"host-resolution="
+            f"{_fmt(record.get('host_resolution_latency'))}s "
+            "(depth-k auto-tune inputs)")
+    compile_info = record.get("compile") or {}
+    if compile_info.get("programs") or compile_info.get("cache_hits") \
+            is not None:
+        lines.append(
+            f"  compile: {compile_info.get('programs', 0)} program(s) "
+            f"{_fmt(compile_info.get('seconds'))}s"
+            + (f", persistent cache {compile_info.get('cache_hits')} hit(s) "
+               f"/ {compile_info.get('cache_misses')} miss(es)"
+               if compile_info.get("cache_hits") is not None else ""))
+    for section in ("final", "numerics", "forensics", "counts"):
+        data = record.get(section)
+        if data:
+            shown = {k: v for k, v in data.items() if v not in (None, 0)}
+            if shown:
+                lines.append(f"  {section}: " + " ".join(
+                    f"{k}={_fmt(v)}" for k, v in shown.items()))
+    phases = record.get("phases") or {}
+    if phases:
+        lines.append(f"  {'phase':<14}{'p50':>10}{'p95':>10}{'n':>6}")
+        for name, stats in phases.items():
+            p50, p95 = stats.get("p50_s"), stats.get("p95_s")
+            lines.append(
+                f"  {name:<14}"
+                f"{(p50 or 0) * 1e3:>8.1f}ms{(p95 or 0) * 1e3:>8.1f}ms"
+                f"{stats.get('count', 0):>6}")
+    return "\n".join(lines)
+
+
+def format_compare(diff: dict[str, Any]) -> str:
+    lines = [f"compare {diff.get('old_id')} -> {diff.get('new_id')}"
+             + ("" if diff.get("fingerprint_match")
+                else "  [WARNING: different config fingerprints — "
+                     "not apples to apples]")]
+    executor = diff.get("executor") or {}
+    if executor.get("old") != executor.get("new"):
+        lines.append(f"  executor: {executor.get('old')} -> "
+                     f"{executor.get('new')}")
+
+    def render(title: str, columns: dict[str, Any], pct: bool = True):
+        rows = []
+        for name, delta in columns.items():
+            if not isinstance(delta, dict) or delta.get("old") is None \
+                    and delta.get("new") is None:
+                continue
+            row = (f"    {name:<26}{_fmt(delta.get('old')):>12}"
+                   f"{_fmt(delta.get('new')):>12}")
+            if "pct" in delta and pct:
+                row += f"{delta['pct']:>+9.1f}%"
+            elif "delta" in delta:
+                row += f"{delta['delta']:>+10.4g}"
+            rows.append(row)
+        if rows:
+            lines.append(f"  {title}:")
+            lines.append(f"    {'column':<26}{'old':>12}{'new':>12}"
+                         f"{'delta':>10}")
+            lines.extend(rows)
+
+    render("perf", diff.get("perf") or {})
+    render("time attribution", diff.get("time_attribution") or {})
+    phase_rows = {f"{name}.p95": (data or {}).get("p95_s")
+                  for name, data in (diff.get("phases") or {}).items()}
+    render("phases", {k: v for k, v in phase_rows.items() if v})
+    render("quality", diff.get("quality") or {}, pct=False)
+    render("numerics", diff.get("numerics") or {}, pct=False)
+    render("forensics", diff.get("forensics") or {}, pct=False)
+    counts = {k: v for k, v in (diff.get("counts") or {}).items()
+              if isinstance(v, dict) and v.get("delta")}
+    render("counts (changed)", counts, pct=False)
+    return "\n".join(lines)
+
+
+def format_regress(verdict: dict[str, Any]) -> str:
+    lines = [
+        f"regress {verdict.get('candidate_id')} vs "
+        f"{verdict.get('baseline_id')}: "
+        + ("PASS" if verdict.get("ok") else "REGRESSION")
+        + f" ({verdict.get('checks')} check(s), rate threshold "
+          f"{verdict.get('rate_threshold_pct')}%"
+        + (f", noise floor {verdict.get('rate_noise_pct')}%"
+           if verdict.get("rate_noise_pct") else "") + ")"]
+    for violation in verdict.get("violations") or []:
+        detail = " ".join(f"{k}={_fmt(v)}" for k, v in violation.items()
+                          if k != "check")
+        lines.append(f"  FAIL {violation.get('check')}: {detail}")
+    return "\n".join(lines)
+
+
+def _store(args) -> LedgerStore:
+    # an explicit --dir beats the env var (the user typed it); without
+    # one, fall back to $ATTACKFL_LEDGER_DIR then ./ledger
+    return LedgerStore(args.dir or resolve_ledger_dir())
+
+
+def _get_or_die(store: LedgerStore, record_id: str) -> dict[str, Any]:
+    record = store.get(record_id)
+    if record is None:
+        print(f"no ledger record {record_id!r} in {store.directory!r}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return record
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="attackfl-tpu ledger",
+        description="Query the persistent cross-run ledger, diff runs and "
+                    "gate regressions.")
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--dir", type=str, default=None,
+                        help="ledger directory (default: "
+                             "$ATTACKFL_LEDGER_DIR or ./ledger)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_list = sub.add_parser("list", parents=[common],
+                            help="index of every recorded run")
+    p_list.add_argument("--fingerprint", type=str, default=None)
+    p_list.add_argument("--executor", type=str, default=None)
+    p_list.add_argument("--json", action="store_true")
+
+    p_show = sub.add_parser("show", parents=[common],
+                            help="one full record")
+    p_show.add_argument("id")
+    p_show.add_argument("--json", action="store_true")
+
+    p_cmp = sub.add_parser("compare", parents=[common],
+                           help="diff two records (or one vs its rolling "
+                                "baseline)")
+    p_cmp.add_argument("a")
+    p_cmp.add_argument("b", nargs="?", default=None)
+    p_cmp.add_argument("--window", type=int, default=5,
+                       help="rolling-baseline depth (records)")
+    p_cmp.add_argument("--json", action="store_true")
+
+    p_reg = sub.add_parser("regress", parents=[common],
+                           help="CI gate: exit 1 on perf/quality regression")
+    p_reg.add_argument("id", nargs="?", default=None,
+                       help="candidate record (default: newest)")
+    p_reg.add_argument("--against", type=str, default=None,
+                       help="explicit baseline record id (default: rolling "
+                            "baseline by config fingerprint)")
+    p_reg.add_argument("--window", type=int, default=5)
+    p_reg.add_argument("--threshold-pct", type=float, default=None,
+                       help="steady-rounds/s slowdown that fails "
+                            "(default 10; noise-floored)")
+    p_reg.add_argument("--json", action="store_true")
+
+    p_imp = sub.add_parser("import", parents=[common],
+                           help="backfill bench artifacts (BENCH_*.json)")
+    p_imp.add_argument("files", nargs="+")
+    p_imp.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+    store = _store(args)
+
+    if args.command == "list":
+        entries = store.index()
+        if args.fingerprint:
+            entries = [e for e in entries
+                       if e.get("fingerprint") == args.fingerprint]
+        if args.executor:
+            entries = [e for e in entries
+                       if e.get("executor") == args.executor]
+        if args.json:
+            print(json.dumps(entries, indent=1))
+        elif not entries:
+            print(f"empty ledger at {store.directory!r}", file=sys.stderr)
+            return 2
+        else:
+            print(format_list(entries))
+        return 0
+
+    if args.command == "show":
+        record = _get_or_die(store, args.id)
+        print(json.dumps(record, indent=1) if args.json
+              else format_record(record))
+        return 0
+
+    if args.command == "compare":
+        new = _get_or_die(store, args.a if args.b is None else args.b)
+        if args.b is None:
+            records, _ = store.load()
+            old = rolling_baseline(records, new, window=args.window)
+            if old is None:
+                print(f"no baseline peers for {args.a!r} (fingerprint "
+                      f"{new.get('fingerprint')!r})", file=sys.stderr)
+                return 2
+        else:
+            old = _get_or_die(store, args.a)
+        diff = compare_records(old, new)
+        print(json.dumps(diff, indent=1) if args.json
+              else format_compare(diff))
+        return 0
+
+    if args.command == "regress":
+        records, _ = store.load()
+        if not records:
+            print(f"empty ledger at {store.directory!r}", file=sys.stderr)
+            return 2
+        candidate = (_get_or_die(store, args.id) if args.id
+                     else records[-1])
+        if args.against:
+            baseline = _get_or_die(store, args.against)
+        else:
+            baseline = rolling_baseline(records, candidate,
+                                        window=args.window)
+            if baseline is None:
+                print(
+                    f"no baseline peers for "
+                    f"{candidate.get('record_id')!r} (fingerprint "
+                    f"{candidate.get('fingerprint')!r}) — nothing to gate",
+                    file=sys.stderr)
+                return 2
+        thresholds = ({"rounds_per_sec_pct": args.threshold_pct}
+                      if args.threshold_pct is not None else None)
+        verdict = regress_check(baseline, candidate, thresholds)
+        print(json.dumps(verdict, indent=1) if args.json
+              else format_regress(verdict))
+        return 0 if verdict["ok"] else 1
+
+    if args.command == "import":
+        imported: list[str] = []
+        problems = 0
+        for path in args.files:
+            try:
+                with open(path) as fh:
+                    parsed = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"skipping {path}: {e}", file=sys.stderr)
+                problems += 1
+                continue
+            records = records_from_bench(parsed) \
+                if isinstance(parsed, dict) else []
+            if not records:
+                print(f"skipping {path}: no recognizable bench metric",
+                      file=sys.stderr)
+                problems += 1
+                continue
+            for record in records:
+                bad = validate_record(record)
+                if bad:
+                    print(f"skipping a record from {path}: {bad}",
+                          file=sys.stderr)
+                    problems += 1
+                    continue
+                rid = store.append(record)
+                imported.append(rid)
+                if not args.json:
+                    print(f"imported {rid} "
+                          f"[{record.get('bench_metric')}"
+                          f"/{record.get('bench_variant')}] from {path}")
+        if args.json:
+            print(json.dumps({"imported": imported,
+                              "skipped": problems}, indent=1))
+        return 0 if imported and not problems else (0 if imported else 2)
+
+    raise AssertionError(f"unhandled command {args.command!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

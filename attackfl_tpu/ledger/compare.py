@@ -1,0 +1,340 @@
+"""Cross-run comparison + the CI regression gate.
+
+``compare_records`` diffs two ledger records across the perf, numerics
+and forensics columns; ``rolling_baseline`` synthesizes a baseline from
+the candidate's own history (median over the last ``window`` records
+sharing its config fingerprint + executor — apples to apples only);
+``regress_check`` turns the diff into pass/fail verdicts with
+noise-aware thresholds.
+
+**Noise awareness** reuses the lesson the ``bench.py
+--numerics-overhead`` paired-means protocol encoded: on a drifting box,
+comparing best-of single observations routinely overstates small deltas
+by more than the delta itself.  So (a) when a record carries per-rep
+rates (bench imports), its MEAN is compared, not its best; (b) the
+effective slowdown threshold is floored by the baseline's own observed
+inter-rep spread (a run can't be declared 10% slower by a gate whose
+baseline wobbles 15% rep-to-rep); (c) the rolling baseline is a median,
+not a max.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any
+
+# Default gate thresholds (overridable from the CLI).
+DEFAULT_THRESHOLDS: dict[str, float] = {
+    # relative steady-rounds/s slowdown (percent) that fails the gate
+    "rounds_per_sec_pct": 10.0,
+    # per-phase p95 regression: relative percent AND an absolute floor
+    # (a 2ms phase doubling is noise, not a regression)
+    "phase_p95_pct": 50.0,
+    "phase_p95_floor_s": 0.010,
+    # quality: absolute drop in roc_auc/accuracy that fails
+    "quality_drop": 0.02,
+    # forensics: absolute TPR drop / FPR rise that fails
+    "tpr_drop": 0.05,
+    "fpr_rise": 0.05,
+    # cap on how far the noise floor can stretch the perf threshold
+    "noise_cap_pct": 30.0,
+}
+
+# The "perf columns" a comparison renders (record key, short label).
+PERF_COLUMNS = (
+    ("rounds_per_sec_steady", "steady r/s"),
+    ("rounds_per_sec_incl_compile", "incl-compile r/s"),
+    ("round_device_time", "device s/round"),
+    ("host_resolution_latency", "host s/round"),
+    ("wall_seconds", "wall s"),
+)
+
+
+def _num(value: Any) -> float | None:
+    if isinstance(value, (int, float)) and not isinstance(value, bool) \
+            and value == value:
+        return float(value)
+    return None
+
+
+def effective_rate(record: dict[str, Any]) -> float | None:
+    """The rate a comparison uses: the mean over reps when the record
+    carries them (paired-means protocol), else the single steady rate."""
+    per_rep = record.get("per_rep")
+    if isinstance(per_rep, list):
+        reps = [v for v in (_num(x) for x in per_rep) if v is not None]
+        if reps:
+            return sum(reps) / len(reps)
+    for key in ("rounds_per_sec_mean", "rounds_per_sec_steady",
+                "rounds_per_sec_incl_compile"):
+        value = _num(record.get(key))
+        if value is not None:
+            return value
+    return None
+
+
+def rate_noise_pct(record: dict[str, Any]) -> float:
+    """Observed inter-rep spread of a record's rate, as percent of its
+    mean (0 when the record has no per-rep data — a single observation
+    carries no self-noise estimate)."""
+    per_rep = record.get("per_rep")
+    if not isinstance(per_rep, list):
+        return 0.0
+    reps = [v for v in (_num(x) for x in per_rep) if v is not None]
+    if len(reps) < 2:
+        return 0.0
+    mean = sum(reps) / len(reps)
+    if mean <= 0:
+        return 0.0
+    return 100.0 * statistics.pstdev(reps) / mean
+
+
+def _delta(old: float | None, new: float | None) -> dict[str, Any]:
+    out: dict[str, Any] = {"old": old, "new": new}
+    if old is not None and new is not None:
+        out["delta"] = round(new - old, 6)
+        if old != 0:
+            out["pct"] = round(100.0 * (new - old) / abs(old), 2)
+    return out
+
+
+def compare_records(old: dict[str, Any],
+                    new: dict[str, Any]) -> dict[str, Any]:
+    """Column-wise diff: perf rates + time attribution, per-phase p95,
+    quality finals, numerics gauges, forensics rates, lifecycle counts."""
+    perf = {key: _delta(_num(old.get(key)), _num(new.get(key)))
+            for key, _ in PERF_COLUMNS}
+    perf["rate_effective"] = _delta(effective_rate(old), effective_rate(new))
+
+    attribution = {}
+    old_attr = old.get("time_attribution") or {}
+    new_attr = new.get("time_attribution") or {}
+    for key in sorted(set(old_attr) | set(new_attr)):
+        attribution[key] = _delta(_num(old_attr.get(key)),
+                                  _num(new_attr.get(key)))
+
+    phases = {}
+    old_phases = old.get("phases") or {}
+    new_phases = new.get("phases") or {}
+    for name in sorted(set(old_phases) | set(new_phases)):
+        phases[name] = {
+            "p50_s": _delta(_num((old_phases.get(name) or {}).get("p50_s")),
+                            _num((new_phases.get(name) or {}).get("p50_s"))),
+            "p95_s": _delta(_num((old_phases.get(name) or {}).get("p95_s")),
+                            _num((new_phases.get(name) or {}).get("p95_s"))),
+        }
+
+    quality = {}
+    for key in sorted(set(old.get("final") or {}) | set(new.get("final")
+                                                        or {})):
+        quality[key] = _delta(_num((old.get("final") or {}).get(key)),
+                              _num((new.get("final") or {}).get(key)))
+
+    numerics = {}
+    old_num = old.get("numerics") or {}
+    new_num = new.get("numerics") or {}
+    for key in sorted(set(old_num) | set(new_num)):
+        numerics[key] = _delta(_num(old_num.get(key)), _num(new_num.get(key)))
+
+    forensics = {}
+    old_for = old.get("forensics") or {}
+    new_for = new.get("forensics") or {}
+    for key in sorted(set(old_for) | set(new_for)):
+        forensics[key] = _delta(_num(old_for.get(key)), _num(new_for.get(key)))
+
+    counts = {}
+    old_counts = old.get("counts") or {}
+    new_counts = new.get("counts") or {}
+    for key in sorted(set(old_counts) | set(new_counts)):
+        counts[key] = _delta(_num(old_counts.get(key)),
+                             _num(new_counts.get(key)))
+
+    return {
+        "old_id": old.get("record_id"),
+        "new_id": new.get("record_id"),
+        "fingerprint_match": (old.get("fingerprint") == new.get("fingerprint")
+                              and bool(old.get("fingerprint"))),
+        "executor": {"old": old.get("executor"), "new": new.get("executor")},
+        "perf": perf,
+        "time_attribution": attribution,
+        "phases": phases,
+        "quality": quality,
+        "numerics": numerics,
+        "forensics": forensics,
+        "counts": counts,
+    }
+
+
+def rolling_baseline(records: list[dict[str, Any]],
+                     candidate: dict[str, Any],
+                     window: int = 5) -> dict[str, Any] | None:
+    """Synthetic baseline record: the median over the last ``window``
+    records sharing the candidate's fingerprint + executor (the candidate
+    itself excluded — by record_id when it has one, by identity
+    otherwise).  None when no peer exists."""
+    fingerprint = candidate.get("fingerprint")
+    peers = [r for r in records
+             if r is not candidate
+             and r.get("fingerprint") == fingerprint
+             and r.get("executor") == candidate.get("executor")
+             and (candidate.get("record_id") is None
+                  or r.get("record_id") != candidate.get("record_id"))]
+    if not peers or not fingerprint:
+        return None
+    peers = peers[-window:]
+
+    def median_of(path: tuple[str, ...]) -> float | None:
+        values = []
+        for record in peers:
+            node: Any = record
+            for key in path:
+                node = (node or {}).get(key) if isinstance(node, dict) \
+                    else None
+            value = _num(node)
+            if value is not None:
+                values.append(value)
+        return statistics.median(values) if values else None
+
+    baseline: dict[str, Any] = {
+        "record_id": f"baseline[{len(peers)}]",
+        "source": "baseline",
+        "fingerprint": fingerprint,
+        "executor": candidate.get("executor"),
+        "baseline_of": [r.get("record_id") for r in peers],
+    }
+    for key, _ in PERF_COLUMNS:
+        baseline[key] = median_of((key,))
+    # effective-rate noise floor: pool the peers' rates as pseudo-reps so
+    # the gate sees the baseline's own run-to-run wobble
+    rates = [effective_rate(r) for r in peers]
+    rates = [r for r in rates if r is not None]
+    if rates:
+        baseline["per_rep"] = [round(r, 6) for r in rates]
+    baseline["phases"] = {}
+    names = {name for r in peers for name in (r.get("phases") or {})}
+    for name in sorted(names):
+        baseline["phases"][name] = {
+            "p50_s": median_of(("phases", name, "p50_s")),
+            "p95_s": median_of(("phases", name, "p95_s")),
+        }
+    baseline["final"] = {
+        key: median_of(("final", key))
+        for key in {k for r in peers for k in (r.get("final") or {})}}
+    baseline["numerics"] = {
+        key: median_of(("numerics", key))
+        for key in {k for r in peers for k in (r.get("numerics") or {})}}
+    if not any(v is not None for v in baseline["numerics"].values()):
+        baseline["numerics"] = None
+    baseline["forensics"] = {
+        key: median_of(("forensics", key))
+        for key in {k for r in peers for k in (r.get("forensics") or {})}}
+    if not any(v is not None for v in baseline["forensics"].values()):
+        baseline["forensics"] = None
+    baseline["counts"] = {}
+    baseline["time_attribution"] = {}
+    return baseline
+
+
+def regress_check(baseline: dict[str, Any], candidate: dict[str, Any],
+                  thresholds: dict[str, float] | None = None
+                  ) -> dict[str, Any]:
+    """Gate verdict: ``{ok, violations: [...], checks: N, ...}`` —
+    ``ok`` is False when any perf/quality/forensics/numerics column
+    regresses past its (noise-floored) threshold."""
+    th = dict(DEFAULT_THRESHOLDS)
+    th.update(thresholds or {})
+    violations: list[dict[str, Any]] = []
+    checks = 0
+
+    # --- perf: steady rounds/s (paired means + noise floor) -----------
+    base_rate = effective_rate(baseline)
+    cand_rate = effective_rate(candidate)
+    noise_pct = min(max(rate_noise_pct(baseline), rate_noise_pct(candidate)),
+                    th["noise_cap_pct"])
+    rate_threshold = max(th["rounds_per_sec_pct"], noise_pct)
+    if base_rate is not None and cand_rate is not None and base_rate > 0:
+        checks += 1
+        drop_pct = 100.0 * (base_rate - cand_rate) / base_rate
+        if drop_pct > rate_threshold:
+            violations.append({
+                "check": "rounds_per_sec",
+                "baseline": round(base_rate, 4),
+                "candidate": round(cand_rate, 4),
+                "drop_pct": round(drop_pct, 2),
+                "threshold_pct": round(rate_threshold, 2),
+            })
+
+    # --- perf: per-phase p95 ------------------------------------------
+    base_phases = baseline.get("phases") or {}
+    cand_phases = candidate.get("phases") or {}
+    for name in sorted(set(base_phases) & set(cand_phases)):
+        old = _num((base_phases.get(name) or {}).get("p95_s"))
+        new = _num((cand_phases.get(name) or {}).get("p95_s"))
+        if old is None or new is None or old <= 0:
+            continue
+        checks += 1
+        if (new - old) > th["phase_p95_floor_s"] \
+                and 100.0 * (new - old) / old > th["phase_p95_pct"]:
+            violations.append({
+                "check": f"phase_p95:{name}",
+                "baseline": round(old, 6), "candidate": round(new, 6),
+                "rise_pct": round(100.0 * (new - old) / old, 2),
+                "threshold_pct": th["phase_p95_pct"],
+            })
+
+    # --- quality: final metric drops ----------------------------------
+    for key in ("roc_auc", "accuracy"):
+        old = _num((baseline.get("final") or {}).get(key))
+        new = _num((candidate.get("final") or {}).get(key))
+        if old is None or new is None:
+            continue
+        checks += 1
+        if (old - new) > th["quality_drop"]:
+            violations.append({
+                "check": f"quality:{key}",
+                "baseline": round(old, 4), "candidate": round(new, 4),
+                "drop": round(old - new, 4),
+                "threshold": th["quality_drop"],
+            })
+
+    # --- forensics: detection quality ---------------------------------
+    base_for = baseline.get("forensics") or {}
+    cand_for = candidate.get("forensics") or {}
+    old_tpr, new_tpr = _num(base_for.get("tpr")), _num(cand_for.get("tpr"))
+    if old_tpr is not None and new_tpr is not None:
+        checks += 1
+        if (old_tpr - new_tpr) > th["tpr_drop"]:
+            violations.append({
+                "check": "forensics:tpr",
+                "baseline": round(old_tpr, 4), "candidate": round(new_tpr, 4),
+                "drop": round(old_tpr - new_tpr, 4),
+                "threshold": th["tpr_drop"]})
+    old_fpr, new_fpr = _num(base_for.get("fpr")), _num(cand_for.get("fpr"))
+    if old_fpr is not None and new_fpr is not None:
+        checks += 1
+        if (new_fpr - old_fpr) > th["fpr_rise"]:
+            violations.append({
+                "check": "forensics:fpr",
+                "baseline": round(old_fpr, 4), "candidate": round(new_fpr, 4),
+                "rise": round(new_fpr - old_fpr, 4),
+                "threshold": th["fpr_rise"]})
+
+    # --- numerics: non-finite values are never an acceptable delta ----
+    old_nf = _num((baseline.get("numerics") or {}).get("nonfinite_total"))
+    new_nf = _num((candidate.get("numerics") or {}).get("nonfinite_total"))
+    if new_nf is not None:
+        checks += 1
+        if new_nf > (old_nf or 0.0):
+            violations.append({
+                "check": "numerics:nonfinite_total",
+                "baseline": old_nf or 0, "candidate": new_nf})
+
+    return {
+        "ok": not violations,
+        "checks": checks,
+        "violations": violations,
+        "baseline_id": baseline.get("record_id"),
+        "candidate_id": candidate.get("record_id"),
+        "rate_threshold_pct": round(rate_threshold, 2),
+        "rate_noise_pct": round(noise_pct, 2),
+    }

@@ -47,6 +47,18 @@ Schema v4 (ISSUE 6) extends v3 — every v1/v2/v3 file still validates:
   ``round`` restored from the manifest entry at ``path`` (round numbers
   in the resumed run continue from there — exactly-once accounting).
 
+Schema v5 (ISSUE 7) extends v4 — every v1-v4 file still validates:
+
+* ``ledger`` — the run's cross-run ledger receipt: ``_finish_run``
+  distilled this run's events into one record of the persistent run
+  ledger (:mod:`attackfl_tpu.ledger`) at ``ledger_path`` under
+  ``record_id``;
+* ``run_header`` MAY carry provenance fields the ledger mines for
+  cross-run comparability: ``git_rev`` (working-tree revision, ``-dirty``
+  suffixed), ``jaxlib_version`` and ``platform`` (the actual device
+  platform, e.g. ``cpu``/``tpu``/``axon``).  Type-checked when present;
+  v1-v4 headers carry none of them.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -63,7 +75,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -100,12 +112,23 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     "degrade": {"state": str, "round": int},
     # crash-safe resume boundary (manifest-driven `--resume`)
     "resume": {"round": int, "path": str},
+    # --- schema v5 kind (ISSUE 7) ---
+    # cross-run ledger receipt: this run's distilled record was appended
+    # to the persistent ledger (attackfl_tpu/ledger) — the id + file it
+    # landed in, so a run directory points at its cross-run history
+    "ledger": {"record_id": str, "ledger_path": str},
 }
 
 # --- schema v3: optional numerics payload on `metric` events ---
 # (type-checked when present; a v1/v2 metric record carries none of these)
 _OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
     "round": int, "broadcast": int, "numerics": dict, "hist": list,
+}
+
+# --- schema v5: optional provenance fields on `run_header` events ---
+# (type-checked when present; v1-v4 headers carry none of these)
+_OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
+    "git_rev": str, "jaxlib_version": str, "platform": str,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -121,6 +144,7 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     2: frozenset({"stall", "attribution", "profile"}),
     3: frozenset(),  # v3 only adds optional fields on `metric`
     4: frozenset({"fault", "degrade", "resume"}),
+    5: frozenset({"ledger"}),  # + optional run_header provenance fields
 }
 
 
@@ -214,6 +238,13 @@ def validate_event(record: Any) -> list[str]:
                                        or not isinstance(record[name], typ)):
                     errors.append(
                         f"[metric] '{name}' must be {typ.__name__}, got "
+                        f"{type(record[name]).__name__}")
+        if kind == "run_header":
+            for name, typ in _OPTIONAL_RUN_HEADER_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[run_header] '{name}' must be {typ.__name__}, got "
                         f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:

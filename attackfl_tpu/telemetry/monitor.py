@@ -11,7 +11,10 @@ grepped logs.  :class:`RunMonitor` runs a stdlib ``http.server`` thread
   completed, last-round phase durations, the rolling-median round time and
   the current stall threshold;
 * ``/last-round`` — the most recent round record as JSON (what
-  ``attackfl-tpu watch`` polls).
+  ``attackfl-tpu watch`` polls);
+* ``/runs`` — the cross-run ledger's index (ISSUE 7): newest-first
+  per-record summaries, so a live monitor also answers "how does this
+  run compare to the last ones".
 
 The **stall watchdog** is a daemon thread that flags the run when no round
 completes within ``stall_factor ×`` the rolling-median round duration
@@ -83,6 +86,10 @@ class RunMonitor:
         # distinct from both healthy (200 ok) and stalled (503): the run
         # IS making progress, just without pipelining
         self._degraded: dict[str, Any] | None = None
+        # cross-run ledger (ISSUE 7): /runs lists the store's index so a
+        # live monitor also answers "how does this run compare to the
+        # last ones" — set by the engine when the ledger is enabled
+        self._ledger = None
         self._server: ThreadingHTTPServer | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -172,6 +179,26 @@ class RunMonitor:
         evidence — round, consecutive failures; None = re-promoted)."""
         with self._lock:
             self._degraded = dict(info) if info else None
+
+    def set_ledger(self, store) -> None:
+        """Attach the cross-run ledger store backing ``/runs`` (the store
+        serializes its own reads; the monitor never writes to it)."""
+        self._ledger = store
+
+    def runs(self, limit: int = 50) -> dict[str, Any]:
+        """``/runs`` payload: the newest ledger index entries (newest
+        first), or an explanatory stub when no ledger is attached."""
+        if self._ledger is None:
+            return {"ledger": None, "records": []}
+        try:
+            entries = self._ledger.index()
+        except Exception as e:  # noqa: BLE001 — observational endpoint
+            return {"ledger": self._ledger.directory,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "records": []}
+        return {"ledger": self._ledger.directory,
+                "count": len(entries),
+                "records": list(reversed(entries[-max(int(limit), 1):]))}
 
     def simulate_hang(self) -> float:
         """Fault injection (``monitor_stall``): rewind the heartbeat past
@@ -330,6 +357,9 @@ class RunMonitor:
                 "text/plain; version=0.0.4"
         elif path == "/last-round":
             code, body, ctype = 200, json.dumps(self.last_round()).encode(), \
+                "application/json"
+        elif path == "/runs":
+            code, body, ctype = 200, json.dumps(self.runs()).encode(), \
                 "application/json"
         else:
             code, body, ctype = 404, b'{"error": "unknown path"}', \

@@ -226,6 +226,35 @@ class Simulator:
             cfg.telemetry.profile_rounds) if self.telemetry.enabled else None)
         self._profiling = False
 
+        # ---- cross-run ledger (ISSUE 7) ---------------------------------
+        # One distilled record per run, appended at _finish_run by pure
+        # event-log post-processing (zero new host syncs).  Process 0 only
+        # under DCN — workers' per-process event files merge through
+        # `metrics --merge`, not the ledger.  The store's startup orphan
+        # sweep rides the same counter as the checkpoint layer's.
+        self._ledger = None
+        self._ledger_events_offset = 0
+        self._ledger_trace_offset = 0
+        self._header_record: dict[str, Any] | None = None
+        if (self.telemetry.enabled and cfg.telemetry.ledger
+                and (not self.multiprocess or jax.process_index() == 0)):
+            from attackfl_tpu.ledger.store import (
+                LedgerStore, resolve_ledger_dir,
+            )
+
+            self._ledger = LedgerStore(resolve_ledger_dir(
+                cfg.telemetry.ledger_dir or None, base=self.telemetry.base_dir))
+            if self._ledger.swept_orphans:
+                self.telemetry.counters.inc(
+                    "orphan_tmp_swept", len(self._ledger.swept_orphans))
+            if self.monitor is not None:
+                self.monitor.set_ledger(self._ledger)
+            try:
+                self._ledger_events_offset = os.path.getsize(
+                    self.telemetry.events.path)
+            except OSError:
+                self._ledger_events_offset = 0
+
         # ---- validation -------------------------------------------------
         self.validation = None
         if cfg.validation:
@@ -728,7 +757,17 @@ class Simulator:
                 "metrics": list(self._numerics.layout.names),
                 "leaf_names": list(self._numerics.layout.leaf_names),
             }
-        tel.events.emit(
+        # schema v5 provenance: the cross-run ledger joins runs on these
+        # (a perf delta is only actionable when the code + toolchain that
+        # produced each side is known)
+        from attackfl_tpu.ledger.record import git_revision
+        try:
+            import jaxlib
+
+            jaxlib_version = getattr(jaxlib, "__version__", "")
+        except ImportError:  # pragma: no cover — jax always ships jaxlib
+            jaxlib_version = ""
+        self._header_record = tel.events.emit(
             "run_header",
             backend=jax.default_backend(),
             num_devices=len(jax.devices()),
@@ -741,6 +780,9 @@ class Simulator:
             attacks=describe_attack_groups(self.attack_groups),
             programs=programs,
             jax_version=jax.__version__,
+            jaxlib_version=jaxlib_version,
+            platform=jax.devices()[0].platform,
+            git_rev=git_revision(),
             compile_cache_dir=self._compile_cache_dir or "",
             fault_plan=[spec.describe() for spec in self.cfg.faults],
             config=dataclasses.asdict(self.cfg),
@@ -838,6 +880,11 @@ class Simulator:
                 drain_error = e
         try:
             self._emit_run_end(history, t_start)
+            # cross-run ledger record (ISSUE 7): distilled AFTER run_end is
+            # on disk so the derivation sees the complete run — and inside
+            # the same try/finally chain, so a crashing round still
+            # records its partial run
+            self._append_ledger_record()
         finally:
             if drain_error is not None:
                 raise drain_error
@@ -874,6 +921,70 @@ class Simulator:
             seconds=round(time.perf_counter() - t_start, 6),
         )
         tel.flush()
+
+    def _append_ledger_record(self) -> None:
+        """Distill THIS run's slice of events.jsonl into one cross-run
+        ledger record and append it (attackfl_tpu/ledger — ISSUE 7).
+
+        Pure post-processing: the event log is line-buffered, so by the
+        time ``_emit_run_end`` has flushed, everything the derivation
+        needs is on disk; the byte offset taken at construction / after
+        the previous run isolates each ``run()`` call's slice when one
+        Simulator runs several times (bench reps).  The host-side trace
+        spans (already in memory) provide the device/host wall-time
+        attribution.  Best-effort by design — a full ledger disk must
+        never fail the run that produced the science."""
+        if self._ledger is None or not self.telemetry.enabled:
+            return
+        try:
+            import json as _json
+
+            from attackfl_tpu.ledger.record import derive_record
+
+            path = self.telemetry.events.path
+            # this run's slice: everything emitted since the previous
+            # ledger append (events.jsonl accumulates across run() calls)
+            offset = self._ledger_events_offset
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                tail = fh.read().decode("utf-8", errors="replace")
+                self._ledger_events_offset = fh.tell()
+            slice_events = []
+            for line in tail.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = _json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(record, dict):
+                    slice_events.append(record)
+            if (self._header_record is not None
+                    and not any(e.get("kind") == "run_header"
+                                for e in slice_events)):
+                slice_events.insert(0, self._header_record)
+            # the tracer accumulates spans across run() calls too: slice
+            # off the spans already attributed to previous records
+            trace_events = getattr(self.telemetry.tracer, "_events", None)
+            if trace_events is not None:
+                trace_tail = trace_events[self._ledger_trace_offset:]
+                self._ledger_trace_offset = len(trace_events)
+                trace_events = trace_tail
+            record = derive_record(
+                slice_events, trace_events=trace_events,
+                fingerprint=self._ckpt_manager.fingerprint)
+            if record is None:
+                return
+            rid = self._ledger.append(record)
+            self.telemetry.counters.inc("ledger_records_appended")
+            self.telemetry.events.emit(
+                "ledger", record_id=rid, ledger_path=self._ledger.path)
+        except Exception as e:  # noqa: BLE001 — observability, fail open
+            self.telemetry.counters.inc("ledger_append_failures")
+            print_with_color(
+                f"[ledger] append failed (run unaffected): "
+                f"{type(e).__name__}: {e}", "yellow")
 
     def _resolve_inflight_validations(self) -> None:
         """Materialize async-validation results (``validation_async``) and

@@ -45,19 +45,17 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+# fingerprinting lives in the jax-free utils/fingerprint module (the
+# ledger CLI needs it without a jax import); re-exported here for the
+# engine/tests that always imported it from this module
+from attackfl_tpu.utils.fingerprint import (  # noqa: F401
+    FINGERPRINT_VOLATILE as _FINGERPRINT_VOLATILE,
+    config_fingerprint,
+    fingerprint_from_dict,
+)
+
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
-
-# Config fields that never change the checkpointed state's structure or
-# trajectory: excluded from the fingerprint so e.g. re-pointing log dirs
-# or turning the pipeline on does not refuse a legitimate resume.
-_FINGERPRINT_VOLATILE = frozenset({
-    "log_path", "checkpoint_dir", "compile_cache_dir", "telemetry",
-    "num_round", "load_parameters", "resume", "faults", "checkpoint_async",
-    "checkpoint_keep", "pipeline", "pipeline_demote_after",
-    "pipeline_repromote_after", "validation_every", "validation_async",
-    "reload_parameters_per_round",
-})
 
 
 def _is_key(x: Any) -> bool:
@@ -103,21 +101,6 @@ def save_state(path: str, state: Any) -> None:
 def content_hash(data: bytes) -> str:
     """The manifest's content-hash contract (hex sha256)."""
     return hashlib.sha256(data).hexdigest()
-
-
-def config_fingerprint(cfg: Any) -> str:
-    """Stable short hash of the state-structure-relevant config fields.
-
-    Recorded in the manifest and compared at resume: a mismatch means the
-    checkpoint was written under a different experiment (model, mode,
-    client count, prng_impl, ...) — surfaced as a loud warning, while
-    volatile knobs (paths, telemetry, executor choice) are excluded so
-    they never block a legitimate resume."""
-    raw = dataclasses.asdict(cfg)
-    for field in _FINGERPRINT_VOLATILE:
-        raw.pop(field, None)
-    blob = json.dumps(raw, sort_keys=True, default=str)
-    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def sweep_orphans(directory: str) -> list[str]:

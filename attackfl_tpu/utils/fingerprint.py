@@ -1,0 +1,55 @@
+"""Config fingerprinting, factored out of ``utils/checkpoint.py``.
+
+The fingerprint is a stable short hash of the state-structure-relevant
+config fields: recorded in checkpoint manifests (resume validation) and
+in run-ledger records (cross-run baseline matching — two runs compare
+perf apples-to-apples only when their experiment config matches).
+
+Deliberately jax-free: the ledger CLI (``attackfl-tpu ledger``) computes
+fingerprints from ``run_header`` config dicts on boxes that only hold the
+artifacts, so this module must import instantly.  ``utils/checkpoint.py``
+re-exports :func:`config_fingerprint` for its existing callers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any
+
+# Config fields that never change the checkpointed state's structure or
+# trajectory: excluded from the fingerprint so e.g. re-pointing log dirs
+# or turning the pipeline on does not refuse a legitimate resume (and,
+# ledger-side, so a sync and a pipelined run of the same experiment share
+# a baseline pool — their params are bit-identical by contract).
+FINGERPRINT_VOLATILE = frozenset({
+    "log_path", "checkpoint_dir", "compile_cache_dir", "telemetry",
+    "num_round", "load_parameters", "resume", "faults", "checkpoint_async",
+    "checkpoint_keep", "pipeline", "pipeline_demote_after",
+    "pipeline_repromote_after", "validation_every", "validation_async",
+    "reload_parameters_per_round",
+})
+
+
+def fingerprint_from_dict(raw: dict[str, Any]) -> str:
+    """Fingerprint a config already in dict form (``dataclasses.asdict``
+    output or a ``run_header``'s JSON-round-tripped ``config`` field —
+    both serialize identically under ``json.dumps``: tuples render as
+    lists either way, so the two sources agree)."""
+    raw = dict(raw)
+    for field in FINGERPRINT_VOLATILE:
+        raw.pop(field, None)
+    blob = json.dumps(raw, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def config_fingerprint(cfg: Any) -> str:
+    """Stable short hash of the state-structure-relevant config fields.
+
+    Recorded in the checkpoint manifest and compared at resume: a
+    mismatch means the checkpoint was written under a different
+    experiment (model, mode, client count, prng_impl, ...) — surfaced as
+    a loud warning, while volatile knobs (paths, telemetry, executor
+    choice) are excluded so they never block a legitimate resume."""
+    return fingerprint_from_dict(dataclasses.asdict(cfg))

@@ -35,6 +35,27 @@ import time
 
 NORTH_STAR_ROUNDS_PER_SEC = 100.0 / 60.0  # BASELINE.json north star
 
+BENCH_LEDGER_BASE = "/tmp/attackfl_bench"
+
+
+def ledger_append(metric_record: dict) -> list[str]:
+    """Append this bench result to the cross-run ledger (ISSUE 7) so the
+    measured trajectory is machine-readable going forward — one record
+    per measured variant (``attackfl_tpu.ledger.record.records_from_bench``
+    is the same mapping ``attackfl-tpu ledger import`` uses on committed
+    BENCH_*.json artifacts).  Destination: ``$ATTACKFL_LEDGER_DIR`` or
+    ``/tmp/attackfl_bench/ledger``.  Best-effort — the bench's one-line
+    JSON contract must survive a read-only ledger disk."""
+    try:
+        from attackfl_tpu.ledger.record import records_from_bench
+        from attackfl_tpu.ledger.store import LedgerStore, resolve_ledger_dir
+
+        store = LedgerStore(resolve_ledger_dir(base=BENCH_LEDGER_BASE))
+        return [store.append(record)
+                for record in records_from_bench(metric_record)]
+    except Exception:  # noqa: BLE001 — observability, never fail the bench
+        return []
+
 
 def _base_kwargs(log_path: str) -> dict:
     """Reference hyperparameters shared by every BASELINE config
@@ -593,35 +614,41 @@ def main() -> None:
         # steady-state north-star constant; label it distinctly so table
         # consumers don't compare incompatible denominators (ADVICE r3 #3)
         deadline_timer.cancel()
-        print(json.dumps(metric_line(
+        line = metric_line(
             metric_name, res[value_key], unit="rounds/s",
             **{vs_key: round(res[value_key] / NORTH_STAR_ROUNDS_PER_SEC, 4)},
             detail=res,
-        )))
+        )
+        ledger_append(line)
+        print(json.dumps(line))
 
     if args.numerics_overhead:
         deadline_timer.cancel()
         res = measure_numerics_overhead(args.rounds, "/tmp/attackfl_bench")
         partial.update(res)
-        print(json.dumps(metric_line(
+        line = metric_line(
             metric_name, res["metrics_on"]["rounds_per_sec_steady"],
             unit="rounds/s",
             overhead_pct=res["overhead_pct"],
             bit_identical_params=res["bit_identical_params"],
             detail=res,
-        )))
+        )
+        ledger_append(line)
+        print(json.dumps(line))
         return
 
     if args.pipeline_compare:
         deadline_timer.cancel()
         res = measure_pipeline_compare(args.rounds, "/tmp/attackfl_bench")
         partial.update(res)
-        print(json.dumps(metric_line(
+        line = metric_line(
             metric_name, res["pipelined_async_ckpt"]["rounds_per_sec_steady"],
             unit="rounds/s",
             vs_sync=res["speedup"],
             detail=res,
-        )))
+        )
+        ledger_append(line)
+        print(json.dumps(line))
         return
 
     if args.compile_cache is not None:
@@ -642,11 +669,13 @@ def main() -> None:
             cfg = _with_dtype(cfg, args.dtype)
         res = measure_compile_cache(cfg, max(args.rounds, 2), args.compile_cache)
         deadline_timer.cancel()
-        print(json.dumps(metric_line(
+        line = metric_line(
             metric_name, res["warm_cache"]["backend_compile_s"], unit="s",
             cold_backend_compile_s=res["first_run"]["backend_compile_s"],
             detail=res,
-        )))
+        )
+        ledger_append(line)
+        print(json.dumps(line))
         return
 
     if args.north_star:  # 1000-client row (BASELINE.json target workload)
@@ -758,11 +787,13 @@ def main() -> None:
             detail["north_star_1000c"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     deadline_timer.cancel()
-    print(json.dumps(metric_line(
+    line = metric_line(
         metric_name, best["rounds_per_sec"], unit="rounds/s",
         vs_baseline=round(best["rounds_per_sec"] / NORTH_STAR_ROUNDS_PER_SEC, 4),
         detail=detail,
-    )))
+    )
+    ledger_append(line)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# One-shot cross-run regression gate (ISSUE 7 satellite), mirroring
+# scripts/audit.sh: exercises `attackfl-tpu ledger regress` — the CI
+# gate with noise-aware thresholds — against the committed ledger corpus
+# (tests/data/ledger_corpus), proving both directions of the contract:
+#
+#   * an identical-run pair PASSES (the gate does not cry wolf on
+#     measurement noise);
+#   * a synthetic 20% rounds/s slowdown FAILS with exit != 0 (the gate
+#     actually bites);
+#   * a quality regression (roc_auc / forensics TPR drop) FAILS too —
+#     perf and quality are one gate.
+#
+# Used by tier-1 through tests/test_ledger.py; run it directly before
+# sending a PR.  To gate a real run directory instead, point --dir at
+# your ledger: `attackfl-tpu ledger regress --dir <run>/ledger`.
+#
+# Usage: scripts/regress.sh [ledger-dir]   (default: the committed corpus)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CORPUS="${1:-tests/data/ledger_corpus}"
+
+# the ledger CLI is jax-free; no backend/platform pinning needed
+python -m attackfl_tpu ledger list --dir "$CORPUS"
+
+echo "--- identical-run pair must pass"
+python -m attackfl_tpu ledger regress base-r2 --against base-r1 --dir "$CORPUS"
+
+echo "--- synthetic 20% rounds/s slowdown must fail (exit != 0)"
+if python -m attackfl_tpu ledger regress slow-20pct --against base-r1 \
+        --dir "$CORPUS"; then
+    echo "regress gate FAILED to flag the synthetic 20% slowdown" >&2
+    exit 1
+fi
+
+echo "--- quality regression (roc_auc + forensics TPR drop) must fail"
+if python -m attackfl_tpu ledger regress auc-drop --against base-r1 \
+        --dir "$CORPUS"; then
+    echo "regress gate FAILED to flag the quality regression" >&2
+    exit 1
+fi
+
+echo "ledger regress gate: OK"

@@ -547,7 +547,7 @@ def test_v4_kinds_registered_and_older_schemas_unchanged():
         KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
     )
 
-    assert SCHEMA_VERSION == 4
+    assert SCHEMA_VERSION >= 4  # v5 (ISSUE 7) added the ledger kind
     assert KINDS_BY_VERSION[4] == frozenset({"fault", "degrade", "resume"})
     # v3 tooling semantics preserved: the new kinds are invisible at v3
     assert not ({"fault", "degrade", "resume"} & known_kinds(3))

@@ -1,0 +1,449 @@
+"""Run ledger & regression observatory (ISSUE 7): record derivation on
+all three executors (+ resume), ledger-on/off bit-identical params, the
+crash-safe store, compare/regress verdicts on the committed corpus, the
+bench backfill, the /runs monitor endpoint, schema v5, and the
+scripts/regress.sh one-shot gate (mirroring the scripts/audit.sh
+pattern)."""
+
+import json
+import os
+import pathlib
+import subprocess
+
+import jax
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.ledger.compare import (
+    compare_records, regress_check, rolling_baseline,
+)
+from attackfl_tpu.ledger.cli import main as ledger_main
+from attackfl_tpu.ledger.record import (
+    derive_record, records_from_bench, validate_record,
+)
+from attackfl_tpu.ledger.store import LedgerStore
+from attackfl_tpu.telemetry.events import validate_event
+from attackfl_tpu.training.engine import Simulator
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = str(REPO / "tests" / "data" / "ledger_corpus")
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128,
+)
+
+
+def _cfg(tmp_path, **kw):
+    path = str(tmp_path)
+    return Config(num_round=3, total_clients=4, mode="fedavg",
+                  log_path=path, checkpoint_dir=path, **BASE, **kw)
+
+
+@pytest.fixture()
+def run_dir(tmp_path, monkeypatch):
+    """Route this test's telemetry + ledger into its own tmp dir (the
+    session-scoped conftest fixture shares one dir across tests)."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("ATTACKFL_LEDGER_DIR", raising=False)
+    return tmp_path
+
+
+def _ledger_records(tmp_path):
+    store = LedgerStore(str(tmp_path / "ledger"))
+    records, skipped = store.load()
+    assert skipped == 0
+    return records
+
+
+def _events(tmp_path):
+    with open(tmp_path / "events.jsonl") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# record derivation: every executor appends a valid record
+# ---------------------------------------------------------------------------
+
+def test_sync_run_appends_record_with_attribution(run_dir, tmp_path):
+    cfg = _cfg(tmp_path,
+               attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                   attack_round=2),))
+    sim = Simulator(cfg)
+    sim.run(verbose=False)
+    sim.close()
+    records = _ledger_records(tmp_path)
+    assert len(records) == 1
+    record = records[0]
+    assert validate_record(record) == []
+    assert record["executor"] == "sync"
+    assert record["source"] == "run"
+    assert not record["resumed"]
+    assert record["rounds"] == record["ok_rounds"] == 3
+    # v5 provenance mined from the run header
+    assert record["jax_version"] == jax.__version__
+    assert record["platform"] == "cpu"
+    assert record["fingerprint"]
+    # device/host wall-time attribution: the sync path's device share is
+    # the train+aggregate phases — nonzero, and inside the wall clock
+    attr = record["time_attribution"]
+    assert attr["device_compute_s"] > 0
+    assert attr["wall_s"] >= attr["device_compute_s"]
+    assert attr["validation_s"] > 0  # validation on by default
+    assert record["round_device_time"] > 0
+    assert record["host_resolution_latency"] >= 0
+    # the run's event log carries the ledger receipt, and it validates
+    events = _events(tmp_path)
+    ledger_events = [e for e in events if e["kind"] == "ledger"]
+    assert len(ledger_events) == 1
+    assert validate_event(ledger_events[0]) == []
+    assert ledger_events[0]["record_id"] == record["record_id"]
+    # run_header carries the v5 provenance fields
+    header = next(e for e in events if e["kind"] == "run_header")
+    assert header["schema"] == 5
+    assert isinstance(header["jaxlib_version"], str)
+    assert header["platform"] == "cpu"
+    assert isinstance(header["git_rev"], str)
+
+
+def test_fused_run_appends_record(run_dir, tmp_path):
+    cfg = _cfg(tmp_path, validation=False)
+    sim = Simulator(cfg)
+    sim.run_fast(verbose=False, save_checkpoints=False)
+    sim.close()
+    record = _ledger_records(tmp_path)[-1]
+    assert validate_record(record) == []
+    assert record["executor"] == "fused"
+    assert record["rounds"] == 3
+    # fused device share = the chunk dispatches, compile subtracted out
+    attr = record["time_attribution"]
+    assert attr["device_compute_s"] > 0
+    assert attr["wall_s"] >= attr["device_compute_s"]
+
+
+def test_pipelined_and_resumed_runs_append_records(run_dir, tmp_path):
+    cfg = _cfg(tmp_path, pipeline=True)
+    sim = Simulator(cfg)
+    sim.run(num_rounds=2, verbose=False)
+    sim.close()
+    record = _ledger_records(tmp_path)[-1]
+    assert record["executor"] == "pipelined"
+    assert record["rounds"] == 2
+
+    resumed = Simulator(_cfg(tmp_path, resume=True))
+    resumed.run(num_rounds=3, verbose=False)
+    resumed.close()
+    records = _ledger_records(tmp_path)
+    assert len(records) == 2
+    assert records[-1]["resumed"] is True
+    assert records[-1]["rounds"] == 1  # continued 2 -> 3: one new round
+    # both runs share the config fingerprint: they are baseline peers
+    assert records[0]["fingerprint"] == records[-1]["fingerprint"]
+
+
+def test_multiple_runs_one_simulator_slice_cleanly(run_dir, tmp_path):
+    """bench-style reps: each run() call gets its own ledger record, with
+    per-run round counts (the events-file byte offset isolates slices)."""
+    cfg = _cfg(tmp_path)
+    sim = Simulator(cfg)
+    sim.run(num_rounds=1, state=sim.init_state(), save_checkpoints=False,
+            verbose=False)
+    sim.run(num_rounds=2, state=sim.init_state(), save_checkpoints=False,
+            verbose=False)
+    sim.close()
+    records = _ledger_records(tmp_path)
+    assert [r["rounds"] for r in records] == [1, 2]
+    # same Simulator => same run_id, but record ids stay unique
+    assert len({r["record_id"] for r in records}) == 2
+    # trace spans are sliced per run too: record 2's device attribution
+    # must not be inflated by record 1's spans
+    for record in records:
+        attr = record["time_attribution"]
+        assert attr["device_compute_s"] <= attr["wall_s"] + 1e-6
+
+
+def test_ledger_on_off_params_bit_identical(run_dir, tmp_path):
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    off = cfg.replace(telemetry=dataclasses.replace(cfg.telemetry,
+                                                    ledger=False))
+    state_off, _ = Simulator(off).run(save_checkpoints=False, verbose=False)
+    # ledger=False really wrote nothing
+    assert not (tmp_path / "ledger").exists()
+    state_on, _ = Simulator(cfg).run(save_checkpoints=False, verbose=False)
+    assert len(_ledger_records(tmp_path)) == 1
+    for a, b in zip(jax.tree.leaves(state_on["global_params"]),
+                    jax.tree.leaves(state_off["global_params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_crashing_run_still_records_partial_run(run_dir, tmp_path):
+    """Ledger emission lives inside the existing _finish_run try/finally:
+    a round that raises mid-run still leaves a ledger record covering the
+    rounds that DID complete."""
+    cfg = _cfg(tmp_path, validation=False)
+    sim = Simulator(cfg)
+    real_round = sim.run_round
+    calls = {"n": 0}
+
+    def exploding(state):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("boom mid-round")
+        return real_round(state)
+
+    sim.run_round = exploding
+    with pytest.raises(RuntimeError, match="boom"):
+        sim.run(save_checkpoints=False, verbose=False)
+    sim.close()
+    records = _ledger_records(tmp_path)
+    assert len(records) == 1
+    assert records[0]["rounds"] == records[0]["ok_rounds"] == 1
+    assert validate_record(records[0]) == []
+
+
+# ---------------------------------------------------------------------------
+# store: crash safety
+# ---------------------------------------------------------------------------
+
+def test_store_orphan_sweep_and_torn_line_tolerance(tmp_path):
+    directory = tmp_path / "store"
+    directory.mkdir()
+    (directory / "index.json.tmp.123.abcd").write_text("{garbage")
+    (directory / "ledger.jsonl.tmp.9").write_text("")
+    store = LedgerStore(str(directory))
+    assert len(store.swept_orphans) == 2
+    store.append({"ledger_schema": 1, "source": "run", "executor": "sync",
+                  "fingerprint": "f", "rounds": 1, "ok_rounds": 1,
+                  "time_attribution": {}, "counts": {}})
+    # tear the file mid-append (a killed process): reader skips + counts
+    with open(store.path, "a") as fh:
+        fh.write('{"ledger_schema": 1, "trunc')
+    records, skipped = store.load()
+    assert len(records) == 1 and skipped == 1
+    # index heals from the JSONL when stale/missing
+    os.unlink(store.index_path)
+    assert len(store.index()) == 1
+
+
+def test_store_id_collisions_get_suffixes(tmp_path):
+    store = LedgerStore(str(tmp_path))
+    base = {"ledger_schema": 1, "source": "run", "executor": "sync",
+            "fingerprint": "f", "rounds": 1, "ok_rounds": 1, "run_id": "dup",
+            "time_attribution": {}, "counts": {}}
+    ids = [store.append(dict(base)) for _ in range(3)]
+    assert ids == ["dup", "dup-2", "dup-3"]
+
+
+# ---------------------------------------------------------------------------
+# compare / regress on the committed corpus
+# ---------------------------------------------------------------------------
+
+def test_corpus_records_validate():
+    records, skipped = LedgerStore(CORPUS).load()
+    assert skipped == 0 and len(records) >= 5
+    for record in records:
+        assert validate_record(record) == [], record.get("record_id")
+
+
+def test_regress_passes_identical_pair_exit_codes(capsys):
+    rc = ledger_main(["regress", "base-r2", "--against", "base-r1",
+                      "--dir", CORPUS])
+    assert rc == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_regress_flags_20pct_slowdown(capsys):
+    rc = ledger_main(["regress", "slow-20pct", "--against", "base-r1",
+                      "--dir", CORPUS])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "rounds_per_sec" in out
+
+
+def test_regress_flags_quality_and_forensics_drop():
+    store = LedgerStore(CORPUS)
+    verdict = regress_check(store.get("base-r1"), store.get("auc-drop"))
+    checks = {v["check"] for v in verdict["violations"]}
+    assert not verdict["ok"]
+    assert {"quality:roc_auc", "forensics:tpr"} <= checks
+
+
+def test_compare_golden_structure():
+    store = LedgerStore(CORPUS)
+    diff = compare_records(store.get("base-r1"), store.get("slow-20pct"))
+    assert diff["fingerprint_match"] is True
+    assert diff["perf"]["rounds_per_sec_steady"]["pct"] == -20.0
+    assert diff["perf"]["round_device_time"]["pct"] > 0
+    assert diff["time_attribution"]["device_compute_s"]["pct"] > 0
+    assert diff["phases"]["train"]["p95_s"]["pct"] == 25.0
+    # untouched columns diff to zero, not to noise
+    assert diff["quality"]["roc_auc"]["delta"] == 0
+    assert diff["forensics"]["tpr"]["delta"] == 0
+
+
+def test_rolling_baseline_matches_fingerprint_peers():
+    records, _ = LedgerStore(CORPUS).load()
+    candidate = next(r for r in records if r["record_id"] == "slow-20pct")
+    baseline = rolling_baseline(records, candidate)
+    assert baseline is not None
+    # peers = the other three sync records of this fingerprint
+    assert set(baseline["baseline_of"]) == {"base-r1", "base-r2", "auc-drop"}
+    # median over peers' steady rates
+    assert baseline["rounds_per_sec_steady"] == 0.742
+    verdict = regress_check(baseline, candidate)
+    assert not verdict["ok"]
+    # a bench record with a different fingerprint has no peers here
+    bench = next(r for r in records if r["source"] == "bench"
+                 and r["executor"] == "sync")
+    assert rolling_baseline(records, bench) is None
+
+
+def test_regress_noise_floor_widens_threshold():
+    """A baseline that wobbles 15% rep-to-rep cannot flag a 12% delta
+    (paired-means protocol: the gate must not outrun its own noise)."""
+    noisy = {"record_id": "n", "fingerprint": "f", "executor": "sync",
+             "per_rep": [1.0, 1.2, 0.85, 1.15]}
+    candidate = {"record_id": "c", "fingerprint": "f", "executor": "sync",
+                 "rounds_per_sec_steady": 0.92}
+    verdict = regress_check(noisy, candidate)
+    assert verdict["rate_threshold_pct"] > 10.0
+    assert verdict["ok"], verdict
+    # the same candidate against a quiet baseline DOES fail
+    quiet = {"record_id": "q", "fingerprint": "f", "executor": "sync",
+             "per_rep": [1.05, 1.05, 1.05, 1.05]}
+    assert not regress_check(quiet, candidate)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# bench backfill
+# ---------------------------------------------------------------------------
+
+def test_import_committed_bench_artifacts(tmp_path, capsys):
+    files = [str(REPO / name) for name in
+             ("BENCH_PIPELINE.json", "BENCH_NUMERICS.json",
+              "BENCH_COMPILE_CACHE.json", "BENCH_r01.json")]
+    rc = ledger_main(["import", *files, "--dir", str(tmp_path)])
+    assert rc == 0
+    records, _ = LedgerStore(str(tmp_path)).load()
+    # 2 pipeline variants + 2 numerics variants + 2 cache variants + 1
+    assert len(records) == 7
+    assert all(validate_record(r) == [] for r in records)
+    by_variant = {(r["bench_metric"], r["bench_variant"]): r
+                  for r in records}
+    pipe = by_variant[("fl_pipeline_vs_sync_rounds_per_sec",
+                       "pipelined_async_ckpt")]
+    assert pipe["executor"] == "pipelined"
+    assert pipe["rounds_per_sec_steady"] == 3.5984
+    assert pipe["per_rep"] == [2.979, 3.3829, 3.5984]
+    warm = by_variant[("fl_compile_cache_warm_vs_cold_s", "warm_cache")]
+    assert warm["compile"]["cache_hits"] == 116
+
+
+def test_bench_ledger_append_helper(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_LEDGER_DIR", str(tmp_path))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    line = json.load(open(REPO / "BENCH_NUMERICS.json"))
+    ids = bench.ledger_append(line)
+    assert len(ids) == 2
+    records, _ = LedgerStore(str(tmp_path)).load()
+    assert {r["bench_variant"] for r in records} == {"metrics_off",
+                                                     "metrics_on"}
+
+
+def test_records_from_bench_rejects_contentless():
+    assert records_from_bench({}) == []
+    assert records_from_bench({"kind": "metric"}) == []
+
+
+# ---------------------------------------------------------------------------
+# derivation is pure post-processing (offline, no engine)
+# ---------------------------------------------------------------------------
+
+def test_derive_record_from_committed_v5_events():
+    events = [json.loads(line) for line in
+              open(REPO / "tests" / "data" / "events.v5.jsonl")]
+    record = derive_record(events)
+    assert record is not None
+    assert validate_record(record) == []
+    assert record["executor"] == "sync"
+    assert record["rounds"] == 3
+    assert record["git_rev"] == "737bf85af847"
+    # no trace spans supplied: attribution degrades to host-resolution
+    # remainder, never crashes
+    assert record["time_attribution"]["device_compute_s"] == 0.0
+    assert record["time_attribution"]["wall_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# monitor /runs endpoint
+# ---------------------------------------------------------------------------
+
+def test_monitor_runs_endpoint(run_dir, tmp_path):
+    import dataclasses
+    import urllib.request
+
+    cfg = _cfg(tmp_path, validation=False)
+    cfg = cfg.replace(telemetry=dataclasses.replace(
+        cfg.telemetry, monitor=True, monitor_port=0))
+    sim = Simulator(cfg)
+    sim.run(num_rounds=2, save_checkpoints=False, verbose=False)
+    try:
+        url = f"http://127.0.0.1:{sim.monitor.port}/runs"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["ledger"].endswith("ledger")
+        assert payload["count"] >= 1
+        newest = payload["records"][0]
+        assert newest["executor"] == "sync"
+        assert newest["rounds"] == 2
+    finally:
+        sim.close()
+
+
+# ---------------------------------------------------------------------------
+# schema v5
+# ---------------------------------------------------------------------------
+
+def test_v5_kinds_registered_and_older_schemas_unchanged():
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
+    )
+
+    assert SCHEMA_VERSION == 5
+    assert KINDS_BY_VERSION[5] == frozenset({"ledger"})
+    assert "ledger" not in known_kinds(4)
+    assert "ledger" in known_kinds(5)
+
+
+def test_v5_optional_header_fields_type_checked():
+    good = {"schema": 5, "kind": "run_header", "ts": 1.0, "run_id": "r",
+            "backend": "cpu", "num_devices": 1, "mode": "fedavg",
+            "model": "CNNModel", "data_name": "ICU",
+            "git_rev": "abc", "jaxlib_version": "0.4.36", "platform": "cpu"}
+    assert validate_event(good) == []
+    bad = dict(good, git_rev=123)
+    assert any("git_rev" in problem for problem in validate_event(bad))
+    # v4-shaped headers (no provenance fields) stay green
+    v4 = {k: v for k, v in good.items()
+          if k not in ("git_rev", "jaxlib_version", "platform")}
+    assert validate_event(dict(v4, schema=4)) == []
+
+
+# ---------------------------------------------------------------------------
+# the one-shot gate script (tier-1 wiring, mirroring scripts/audit.sh)
+# ---------------------------------------------------------------------------
+
+def test_regress_sh_gate_passes_on_committed_corpus():
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "regress.sh")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ledger regress gate: OK" in proc.stdout
+    assert "REGRESSION" in proc.stdout  # the synthetic slowdown WAS flagged

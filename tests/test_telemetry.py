@@ -59,7 +59,10 @@ def test_run_emits_valid_events_and_metrics_summary(tmp_path, monkeypatch, capsy
     kinds = [e["kind"] for e in events]
     assert kinds[0] == "run_header"
     assert kinds.count("round") == 2
-    assert "counters" in kinds and kinds[-1] == "run_end"
+    # the run closes with counters + run_end; the cross-run ledger receipt
+    # (ISSUE 7) lands after run_end — it is derived FROM the closed run
+    assert "counters" in kinds and kinds[-1] == "ledger"
+    assert kinds[-2] == "run_end"
 
     header = events[0]
     assert header["mode"] == "fedavg" and header["total_clients"] == 4
