@@ -61,6 +61,11 @@ FAULTS_FILES = (
     REPO / "attackfl_tpu" / "faults" / "plan.py",
     REPO / "attackfl_tpu" / "faults" / "inject.py",
 )
+# the run service (ISSUE 8): pure host-side orchestration over the
+# engine's audited paths — it must never materialize device values
+# itself (NO allowlisted functions by design; every sync a worker needs
+# already lives behind the engine's audited resolve points)
+SERVICE_DIR = REPO / "attackfl_tpu" / "service"
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -228,7 +233,7 @@ def resolve_host_sync_allowlist() -> list[Finding]:
 
 def host_sync_files() -> list[Path]:
     return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
-            + list(FAULTS_FILES))
+            + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py")))
 
 
 @register(

@@ -23,7 +23,12 @@ section instead.
   (``--monitor`` on run/server) and print each new round as it lands;
 * ``attackfl-tpu ledger`` — the persistent cross-run store:
   list/show/compare records, ``regress`` = the CI gate, ``import`` =
-  backfill committed BENCH_*.json artifacts.
+  backfill committed BENCH_*.json artifacts;
+* ``attackfl-tpu serve`` — the resilient run service (ISSUE 8): a
+  persistent daemon with a durable job queue, supervised workers,
+  admission control and crash recovery;
+* ``attackfl-tpu job`` — the jax-free service client
+  (submit/list/status/cancel/wait over HTTP).
 """
 
 from __future__ import annotations
@@ -306,12 +311,25 @@ def _http_get_json(url: str, timeout: float = 5.0):
         return resp.status, json.loads(resp.read().decode() or "{}")
 
 
+def _watch_backoff(failures: int, interval: float, cap: float = 60.0) -> float:
+    """Capped exponential backoff for unreachable monitors: the normal
+    poll period for the first miss, doubling per consecutive miss, never
+    above ``cap``.  A service restart (seconds of connection-refused)
+    costs a few quick retries instead of a crash or a minute-long gap."""
+    return min(interval * (2 ** max(failures - 1, 0)), cap)
+
+
 def watch_main(argv=None) -> int:
     """``attackfl-tpu watch``: thin poller of a live run's monitor
     endpoint (``--monitor`` on run/server) — prints each new round as it
     completes and shouts when ``/healthz`` flips to stalled.  This
     replaces the retired ``scripts/tpu_watch.sh`` loop: liveness now comes
-    from the run itself, not from out-of-process probe jobs."""
+    from the run itself, not from out-of-process probe jobs.
+
+    Connection-refused / connection-reset (a run-service restart, a
+    monitor rebinding) is survived with capped exponential backoff — the
+    poller retries forever rather than crashing mid-watch."""
+    import http.client
     import urllib.error
 
     parser = argparse.ArgumentParser(
@@ -321,6 +339,9 @@ def watch_main(argv=None) -> int:
                         help="monitor base URL (printed at run start)")
     parser.add_argument("--interval", type=float, default=5.0,
                         help="poll period in seconds (default 5)")
+    parser.add_argument("--max-backoff", type=float, default=60.0,
+                        help="cap for the unreachable-retry backoff "
+                             "(default 60s)")
     parser.add_argument("--once", action="store_true",
                         help="single poll: exit 0 healthy, 1 stalled, "
                              "2 unreachable")
@@ -330,17 +351,26 @@ def watch_main(argv=None) -> int:
     seen_round = object()
     stalled = False
     degraded = False
+    failures = 0
     while True:
         try:
             code, health = _http_get_json(base + "/healthz")
         except urllib.error.HTTPError as e:
             code, health = e.code, {"status": f"http {e.code}"}
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            print(f"[watch] {base} unreachable: {e}", file=sys.stderr)
+        except (urllib.error.URLError, http.client.HTTPException, OSError,
+                ValueError) as e:
+            # connection refused/reset — the service is restarting or the
+            # monitor is rebinding; back off (capped) and keep polling
+            failures += 1
+            delay = _watch_backoff(failures, args.interval,
+                                   args.max_backoff)
+            print(f"[watch] {base} unreachable: {e} "
+                  f"(retry {failures} in {delay:.1f}s)", file=sys.stderr)
             if args.once:
                 return 2
-            time.sleep(args.interval)
+            time.sleep(delay)
             continue
+        failures = 0
         try:
             _, last = _http_get_json(base + "/last-round")
         except Exception:  # noqa: BLE001 — health is the primary signal
@@ -398,6 +428,27 @@ def audit_main(argv=None) -> int:
     return _audit_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def serve_main(argv=None) -> int:
+    """``attackfl-tpu serve``: the resilient run service (ISSUE 8) — a
+    persistent daemon with a durable on-disk job queue, supervised
+    workers (restart-with-backoff, retry budget), admission control, an
+    HTTP control plane (submit/status/cancel + aggregate /healthz) and
+    crash recovery (kill -9 → queue replay → checkpoint resume).
+    SIGTERM drains gracefully: in-flight rounds finish, the rest
+    requeues."""
+    from attackfl_tpu.service.cli import serve_main as _serve_main
+
+    return _serve_main(list(sys.argv[1:] if argv is None else argv))
+
+
+def job_main(argv=None) -> int:
+    """``attackfl-tpu job``: jax-free run-service client —
+    submit/list/status/cancel/wait against a live ``serve`` daemon."""
+    from attackfl_tpu.service.cli import job_main as _job_main
+
+    return _job_main(list(sys.argv[1:] if argv is None else argv))
+
+
 def ledger_main(argv=None) -> int:
     """``attackfl-tpu ledger``: the persistent cross-run store —
     ``list``/``show`` query it, ``compare`` diffs two runs (or a run
@@ -417,6 +468,8 @@ _SUBCOMMANDS = {
     "watch": watch_main,
     "audit": audit_main,
     "ledger": ledger_main,
+    "serve": serve_main,
+    "job": job_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -434,6 +487,11 @@ commands:
   ledger   persistent cross-run store: list/show records, compare two runs
            (perf + numerics + forensics columns), regress = CI gate with
            noise-aware thresholds, import = backfill BENCH_*.json
+  serve    resilient run service: durable job queue + supervised workers +
+           admission control + HTTP control plane; SIGTERM drains, kill -9
+           is recovered by queue replay + checkpoint resume
+  job      service client (jax-free): submit/list/status/cancel/wait over
+           HTTP (reads <spool>/service.json for discovery)
 """
 
 

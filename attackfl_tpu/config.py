@@ -156,6 +156,66 @@ class TelemetryConfig:
 
 
 @dataclass(frozen=True)
+class ServiceConfig:
+    """Run-service daemon knobs (``attackfl-tpu serve`` — ISSUE 8).
+
+    ``spool_dir`` holds the durable job queue, the service event log, the
+    shared cross-run ledger and one working directory per job (telemetry
+    + checkpoints) — everything the daemon needs to recover after a kill
+    -9 lives under it.  ``port`` is the control plane's HTTP port (0 =
+    ephemeral; the ACTUAL port is printed at startup and published in
+    ``<spool>/service.json``).  ``max_workers`` bounds concurrent runs
+    (they share the persistent compile cache and the device pool);
+    ``queue_depth`` bounds queued+running jobs — submission beyond it is
+    an EXPLICIT rejection (HTTP 429 + a ``job`` event), never a silent
+    drop.  A crashed worker is restarted with exponential backoff (base
+    ``worker_backoff`` seconds, doubling, capped at
+    ``worker_backoff_cap``) up to ``worker_retries`` restarts, then the
+    job is marked failed without taking down the service.
+    ``run_monitors`` gives every job its own live monitor on an
+    ephemeral port (stall watchdog + per-run /metrics; the service-level
+    /healthz aggregates their states).  ``drain_grace_seconds`` bounds
+    how long a SIGTERM drain waits for in-flight rounds before the
+    daemon exits anyway (the queue replay recovers whatever was cut
+    short).
+    """
+
+    spool_dir: str = ""
+    port: int = 8781
+    host: str = "0.0.0.0"
+    max_workers: int = 1
+    queue_depth: int = 16
+    worker_retries: int = 2
+    worker_backoff: float = 0.5
+    worker_backoff_cap: float = 30.0
+    run_monitors: bool = True
+    drain_grace_seconds: float = 120.0
+
+    def __post_init__(self):
+        if not 0 <= self.port <= 65535:
+            raise ValueError(
+                f"service.port must be a port, got {self.port}")
+        if self.max_workers < 1:
+            raise ValueError(
+                f"service.max_workers must be >= 1, got {self.max_workers}")
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"service.queue_depth must be >= 1, got {self.queue_depth}")
+        if self.worker_retries < 0:
+            raise ValueError(
+                f"service.worker_retries must be >= 0, got "
+                f"{self.worker_retries}")
+        if self.worker_backoff <= 0 or self.worker_backoff_cap <= 0:
+            raise ValueError(
+                "service.worker_backoff and worker_backoff_cap must be > 0, "
+                f"got {self.worker_backoff} / {self.worker_backoff_cap}")
+        if self.drain_grace_seconds <= 0:
+            raise ValueError(
+                f"service.drain_grace_seconds must be > 0, got "
+                f"{self.drain_grace_seconds}")
+
+
+@dataclass(frozen=True)
 class AttackSpec:
     """One group of attacker clients.
 
@@ -322,6 +382,9 @@ class Config:
     # --- infra ---
     mesh: MeshConfig = field(default_factory=MeshConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    # run-service daemon knobs (`attackfl-tpu serve` reads these as its
+    # defaults; a plain `run` never consults them)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
     log_path: str = "."
     checkpoint_dir: str = "."
     # JAX persistent compilation cache directory: compiled XLA programs
@@ -523,6 +586,7 @@ def config_from_dict(raw: dict) -> Config:
     ndr = _get(dist, "num-data-range", [12000, 15000])
     mesh = _get(raw, "tpu", {})
     tele = _get(raw, "telemetry", {})
+    svc = _get(raw, "service", {})
 
     attacks = []
     for a in _get(raw, "attack-clients", []) or []:
@@ -610,6 +674,19 @@ def config_from_dict(raw: dict) -> Config:
             numerics_window=int(_get(tele, "numerics-window", 16)),
             ledger=bool(_get(tele, "ledger", True)),
             ledger_dir=str(_get(tele, "ledger-dir", "")),
+        ),
+        service=ServiceConfig(
+            spool_dir=str(_get(svc, "spool-dir", "")),
+            port=int(_get(svc, "port", 8781)),
+            host=str(_get(svc, "host", "0.0.0.0")),
+            max_workers=int(_get(svc, "max-workers", 1)),
+            queue_depth=int(_get(svc, "queue-depth", 16)),
+            worker_retries=int(_get(svc, "worker-retries", 2)),
+            worker_backoff=float(_get(svc, "worker-backoff", 0.5)),
+            worker_backoff_cap=float(_get(svc, "worker-backoff-cap", 30.0)),
+            run_monitors=bool(_get(svc, "run-monitors", True)),
+            drain_grace_seconds=float(
+                _get(svc, "drain-grace-seconds", 120.0)),
         ),
         log_path=str(_get(raw, "log_path", ".")),
         checkpoint_dir=str(_get(raw, "checkpoint-dir", _get(raw, "log_path", "."))),

@@ -11,10 +11,12 @@ file that runs under trace is sync-free (held to the host-sync lint like
 the training package).
 
 Host side: :class:`HostFaultInjector` is the single object the
-checkpoint manager, the async-writer wiring and the round loops consult.
-It owns the consumable fault state (remaining ``ckpt_write_error``
-counts, fired-once latches) and emits the schema-v4 ``fault`` event for
-every injection so a chaos run's event log is its own ground truth.
+checkpoint manager, the async-writer wiring, the round loops and the run
+service (ISSUE 8 — worker supervision, queue publish, admission control)
+consult.  It owns the consumable fault state (remaining
+``ckpt_write_error`` counts, fired-once latches) and emits the schema'd
+``fault`` event for every injection so a chaos run's event log is its
+own ground truth.
 """
 
 from __future__ import annotations
@@ -26,6 +28,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from attackfl_tpu.faults.plan import DEVICE_FAULT_KINDS, FaultSpec, device_specs
+
+
+class WorkerDeathError(RuntimeError):
+    """Injected run-service worker crash (``worker_death`` fault): raised
+    out of the worker's per-round stop hook so it propagates through the
+    run's ``finally`` chain (checkpoint drain, run_end, ledger record)
+    exactly like a real mid-run crash that Python can still observe —
+    the harsher no-cleanup crash class is covered by the kill -9 chaos
+    test."""
 
 
 def build_client_fault_fn(
@@ -178,6 +189,57 @@ class HostFaultInjector:
             self._fired.add(key)
             writer.inject_thread_death()
             self._emit("writer_death", round_no)
+
+    # ---- run-service seams (ISSUE 8) --------------------------------
+    def maybe_worker_death(self, completed_rounds: int) -> None:
+        """Called from the service worker's per-round stop hook.  Raises
+        :class:`WorkerDeathError` once when an armed ``worker_death``
+        round is reached — the worker's supervisor must catch it, back
+        off, and restart the job with ``--resume`` semantics."""
+        for _spec in self._specs("worker_death", completed_rounds):
+            key = ("worker_death", completed_rounds)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            self._emit("worker_death", completed_rounds)
+            raise WorkerDeathError(
+                f"injected worker death (fault plan, after "
+                f"{completed_rounds} completed rounds)")
+
+    def on_status_publish(self, seq: int, path: str) -> None:
+        """Called after the job queue's ``seq``-th status publish landed.
+        A ``queue_torn`` spec truncates the entry to half its bytes — the
+        seal keeps the honest hash, so replay must reject the entry and
+        requeue the job from its spec + newest checkpoint."""
+        for _spec in self._specs("queue_torn", seq):
+            key = ("queue_torn", seq)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            try:
+                import os
+
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    fh.truncate(max(size // 2, 1))
+            except OSError:
+                continue  # nothing to tear (publish itself failed)
+            self._emit("queue_torn", seq, path=path,
+                       truncated_to=max(size // 2, 1), original_bytes=size)
+
+    def flood_count(self, seq: int) -> int:
+        """Called at the top of the queue's ``seq``-th submission.  An
+        armed ``submit_flood`` returns how many duplicate submissions to
+        inject (admission control must reject the overflow explicitly);
+        0 otherwise."""
+        for spec in self._specs("submit_flood", seq):
+            key = ("submit_flood", seq)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            self._emit("submit_flood", seq, count=spec.count)
+            return spec.count
+        return 0
 
     # ---- monitor seam -----------------------------------------------
     def maybe_stall_monitor(self, round_no: int, monitor) -> None:

@@ -36,6 +36,22 @@ Host-side (consulted by the checkpoint/monitor layers through
   round's submit (the supervisor must restart it).
 * ``monitor_stall`` — rewind the live monitor's heartbeat past the stall
   threshold so the watchdog deterministically fires.
+
+Service-side (ISSUE 8 — consulted by :mod:`attackfl_tpu.service` through
+the same :class:`~attackfl_tpu.faults.inject.HostFaultInjector`, so every
+run-service recovery path is deterministically chaos-testable):
+
+* ``worker_death`` — the worker executing a run raises once its job
+  reaches ``round`` completed rounds (the per-round stop hook is the
+  seam): the service must restart it with bounded backoff and the
+  restarted attempt must resume from the newest valid checkpoint.
+* ``queue_torn`` — truncate the job queue's ``round``-th status publish
+  right after it lands (a torn spool entry whose seal no longer
+  verifies); queue replay must detect it and requeue the job instead of
+  trusting — or silently dropping — the entry.
+* ``submit_flood`` — on the ``round``-th submission, inject ``count``
+  duplicate submissions: admission control must reject the overflow
+  explicitly (a ``job`` event per rejection), never drop it silently.
 """
 
 from __future__ import annotations
@@ -47,7 +63,8 @@ DEVICE_FAULT_KINDS = ("nan_storm", "dropout")
 HOST_FAULT_KINDS = (
     "ckpt_write_error", "ckpt_torn", "writer_death", "monitor_stall",
 )
-FAULT_KINDS = DEVICE_FAULT_KINDS + HOST_FAULT_KINDS
+SERVICE_FAULT_KINDS = ("worker_death", "queue_torn", "submit_flood")
+FAULT_KINDS = DEVICE_FAULT_KINDS + HOST_FAULT_KINDS + SERVICE_FAULT_KINDS
 
 
 @dataclass(frozen=True)
@@ -56,10 +73,14 @@ class FaultSpec:
 
     ``round`` is 1-based: the broadcast number for device-side kinds (the
     clock attacks already key on), the completed-round number for
-    host-side kinds (the clock checkpoints key on).  ``clients`` selects
-    the target cohort for device-side kinds (empty = every client);
-    ``count`` is how many consecutive write attempts fail for
-    ``ckpt_write_error``.
+    host-side kinds (the clock checkpoints key on), and the service's own
+    deterministic counters for service-side kinds (a job's completed
+    rounds for ``worker_death``, the n-th status publish for
+    ``queue_torn``, the n-th submission for ``submit_flood``).
+    ``clients`` selects the target cohort for device-side kinds (empty =
+    every client); ``count`` is how many consecutive write attempts fail
+    for ``ckpt_write_error`` and how many duplicate submissions a
+    ``submit_flood`` injects.
     """
 
     kind: str
@@ -87,7 +108,7 @@ class FaultSpec:
         out: dict[str, Any] = {"fault": self.kind, "round": self.round}
         if self.clients:
             out["clients"] = list(self.clients)
-        if self.kind == "ckpt_write_error":
+        if self.kind in ("ckpt_write_error", "submit_flood"):
             out["count"] = self.count
         return out
 

@@ -18,6 +18,15 @@ Crash-safety mirrors ``utils/checkpoint`` (ISSUE 6): orphaned
 ``index.json.tmp*`` temps from killed writes are swept at store open
 (surfaced through the existing ``orphan_tmp_swept`` counter by the
 engine), and a failed index write unlinks its own temp.
+
+Multi-writer safety (ISSUE 8): the run service executes N concurrent
+runs whose Simulators each hold their OWN ``LedgerStore`` over the one
+shared service ledger, so the in-instance ``threading.Lock`` no longer
+serializes appends.  :meth:`LedgerStore.append` therefore also takes an
+advisory ``fcntl`` lock on a sidecar ``ledger.lock`` file around the
+JSONL append + index republish: the append stays atomic across
+instances AND processes, id-collision suffixes are assigned under the
+lock, and the index never loses a record to a concurrent republish.
 """
 
 from __future__ import annotations
@@ -28,9 +37,12 @@ import threading
 import uuid
 from typing import Any, Iterable
 
+from attackfl_tpu.utils.atomicio import file_lock, write_bytes_atomic
+
 ENV_LEDGER_DIR = "ATTACKFL_LEDGER_DIR"
 LEDGER_NAME = "ledger.jsonl"
 INDEX_NAME = "index.json"
+LOCK_NAME = "ledger.lock"
 INDEX_VERSION = 1
 
 # The per-record summary the index carries (and `ledger list` renders).
@@ -50,27 +62,18 @@ def resolve_ledger_dir(explicit: str | None = None,
 
 
 def _write_json_atomic(path: str, payload: Any) -> None:
-    """Temp + fsync + rename publish (the checkpoint `_write_bytes`
-    pattern, jax-free); a failed write unlinks its own temp."""
-    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
-    try:
-        with open(tmp, "w") as fh:
-            json.dump(payload, fh)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    """Temp + fsync + rename publish (utils/atomicio, jax-free); the
+    pid+uuid temp suffix keeps concurrent writers' temps distinct."""
+    write_bytes_atomic(
+        path, json.dumps(payload).encode(),
+        tmp_suffix=f".tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}")
 
 
-def sweep_orphans(directory: str) -> list[str]:
+def sweep_orphans(directory: str, dry_run: bool = False) -> list[str]:
     """Remove ``index.json.tmp*`` / ``ledger.jsonl.tmp*`` leftovers from
     killed writes (only the ledger's own temp patterns — the directory
-    may be shared).  Returns the removed paths."""
+    may be shared).  Returns the removed (or, with ``dry_run``, the
+    matching) paths."""
     removed: list[str] = []
     try:
         names = os.listdir(directory or ".")
@@ -81,10 +84,11 @@ def sweep_orphans(directory: str) -> list[str]:
                 or name.startswith(LEDGER_NAME + ".tmp")):
             continue
         path = os.path.join(directory or ".", name)
-        try:
-            os.unlink(path)
-        except OSError:
-            continue
+        if not dry_run:
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
         removed.append(path)
     return removed
 
@@ -99,8 +103,19 @@ class LedgerStore:
         os.makedirs(self.directory, exist_ok=True)
         self.path = os.path.join(self.directory, LEDGER_NAME)
         self.index_path = os.path.join(self.directory, INDEX_NAME)
+        self.lock_path = os.path.join(self.directory, LOCK_NAME)
         self._lock = threading.Lock()
-        self.swept_orphans = sweep_orphans(self.directory)
+        # sweep under the file lock: a store opening while a sibling
+        # instance republishes the index must not delete the live temp
+        # out from under that writer's os.replace.  The lock file is
+        # only materialized when there is something to sweep (or an
+        # append happens later) — opening a committed/read-only ledger
+        # dir for queries must not litter it.
+        if sweep_orphans(self.directory, dry_run=True):
+            with file_lock(self.lock_path):
+                self.swept_orphans = sweep_orphans(self.directory)
+        else:
+            self.swept_orphans = []
 
     # ------------------------------------------------------------------
     # writes
@@ -112,8 +127,15 @@ class LedgerStore:
         The JSONL append lands first (flush+fsync — the record is durable
         before the index names it), then the index is atomically
         republished.  An id collision (same run_id appended twice, e.g.
-        bench reps sharing a Simulator) gets a ``-N`` suffix."""
-        with self._lock:
+        bench reps sharing a Simulator) gets a ``-N`` suffix.
+
+        Serialized twice over: the instance lock (monitor thread vs the
+        round loop) AND an advisory file lock, because N service workers
+        each hold their own store instance over this one directory — the
+        index reload, the collision-suffix assignment, the JSONL append
+        and the index republish must be one atomic step across all of
+        them."""
+        with self._lock, file_lock(self.lock_path):
             index = self._load_index_unlocked()
             taken = {e.get("record_id") for e in index}
             rid = str(record.get("record_id") or record.get("run_id")

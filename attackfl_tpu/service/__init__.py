@@ -1,0 +1,23 @@
+"""Resilient run service (ISSUE 8): the layer that turns `a script you
+run` into `a system that serves`.
+
+``attackfl-tpu serve`` promotes the CLI into a persistent daemon:
+
+* :mod:`attackfl_tpu.service.queue` — the durable on-disk job queue
+  (atomic temp+fsync+rename spool with sealed-entry torn detection);
+* :mod:`attackfl_tpu.service.worker` — one supervised worker per
+  running job: isolated telemetry/checkpoint directory, shared ledger
+  record, restart-with-backoff on crashes, graceful-drain stop hook;
+* :mod:`attackfl_tpu.service.daemon` — the :class:`RunService` itself:
+  admission control, queue replay + resume after kill -9, SIGTERM
+  drain, and the HTTP control plane (submit/status/cancel beside the
+  monitor-layer endpoints);
+* :mod:`attackfl_tpu.service.cli` — ``serve`` (the daemon) and the
+  jax-free ``job`` client (submit/list/status/cancel/wait).
+
+Every recovery path is deterministically chaos-testable through the
+fault plan's service kinds (``worker_death``, ``queue_torn``,
+``submit_flood`` — :mod:`attackfl_tpu.faults`).
+"""
+
+from attackfl_tpu.service.queue import Job, JobQueue, QueueFullError  # noqa: F401

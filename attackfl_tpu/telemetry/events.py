@@ -59,6 +59,19 @@ Schema v5 (ISSUE 7) extends v4 — every v1-v4 file still validates:
   platform, e.g. ``cpu``/``tpu``/``axon``).  Type-checked when present;
   v1-v4 headers carry none of them.
 
+Schema v6 (ISSUE 8) extends v5 — every v1-v5 file still validates:
+
+* ``job`` — one run-service job lifecycle transition (``job_id`` +
+  ``action`` = submitted/rejected/started/retried/requeued/completed/
+  failed/cancelled) from :mod:`attackfl_tpu.service`;
+* ``service`` — the service's own lifecycle (``action`` = started/
+  replayed/draining/drained/stopped), including crash-recovery replay
+  evidence (requeued + torn-entry counts);
+* ``run_header`` MAY carry ``monitor_port`` — the live monitor's ACTUAL
+  bound port (``monitor-port: 0`` binds ephemeral), so tooling reading a
+  run directory can find its health endpoint.  Type-checked when
+  present; v1-v5 headers carry none of it.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -75,7 +88,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -117,6 +130,14 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # to the persistent ledger (attackfl_tpu/ledger) — the id + file it
     # landed in, so a run directory points at its cross-run history
     "ledger": {"record_id": str, "ledger_path": str},
+    # --- schema v6 kinds (ISSUE 8) ---
+    # run-service job lifecycle: one record per state transition
+    # (attackfl_tpu/service) — submitted/rejected/started/retried/
+    # requeued/completed/failed/cancelled
+    "job": {"job_id": str, "action": str},
+    # the service daemon's own lifecycle: started/replayed/draining/
+    # drained/stopped, with crash-recovery replay evidence riding along
+    "service": {"action": str},
 }
 
 # --- schema v3: optional numerics payload on `metric` events ---
@@ -125,10 +146,12 @@ _OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
     "round": int, "broadcast": int, "numerics": dict, "hist": list,
 }
 
-# --- schema v5: optional provenance fields on `run_header` events ---
-# (type-checked when present; v1-v4 headers carry none of these)
+# --- schema v5/v6: optional provenance fields on `run_header` events ---
+# (type-checked when present; v1-v4 headers carry none of these;
+# monitor_port — the ACTUAL bound port under `monitor-port: 0` — is v6)
 _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     "git_rev": str, "jaxlib_version": str, "platform": str,
+    "monitor_port": int,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -145,6 +168,7 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     3: frozenset(),  # v3 only adds optional fields on `metric`
     4: frozenset({"fault", "degrade", "resume"}),
     5: frozenset({"ledger"}),  # + optional run_header provenance fields
+    6: frozenset({"job", "service"}),  # + optional run_header monitor_port
 }
 
 

@@ -27,6 +27,12 @@ lock-serialized for exactly this cross-thread write) and bumps the
 
 Everything here is observational: the monitor never touches simulation
 state, and with ``telemetry.enabled: false`` it is never constructed.
+
+The HTTP plumbing itself — bind (``port 0`` = ephemeral, busy fixed port
+falls back to ephemeral), a method+path route table, JSON/text response
+encoding — lives in :class:`JsonHTTPServer` so the run service's control
+plane (:mod:`attackfl_tpu.service` — ISSUE 8) extends the SAME layer with
+its submit/status/cancel endpoints instead of growing a second server.
 """
 
 from __future__ import annotations
@@ -37,7 +43,7 @@ import threading
 import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Callable
 
 # Absolute floor for the stall threshold: with sub-second rounds a single
 # GC pause or checkpoint fsync must not trip the watchdog.
@@ -47,6 +53,100 @@ MIN_STALL_SECONDS = 5.0
 def _sanitize(name: str) -> str:
     """Counter name -> Prometheus metric-name charset."""
     return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+class JsonHTTPServer:
+    """Threaded stdlib HTTP server with a route table (shared by the run
+    monitor and the run-service control plane).
+
+    Routes are ``(method, path) -> handler``; a handler receives the
+    parsed query dict and the raw request body (POSTs) and returns either
+    ``(code, payload_dict)`` — encoded as JSON — or ``(code, bytes,
+    content_type)`` for pre-encoded bodies (``/metrics`` text).  Binding
+    honors ``port 0`` as "ephemeral, report the real port"; a busy FIXED
+    port also falls back to ephemeral — an observability/control thread
+    must never kill the run it serves — with the actual port exposed via
+    :attr:`port`.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 name: str = "attackfl-http"):
+        self._host = host
+        self._requested_port = int(port)
+        self._name = name
+        self._routes: dict[tuple[str, str], Callable] = {}
+        self._server: ThreadingHTTPServer | None = None
+        self.port: int | None = None
+
+    def route(self, method: str, path: str, handler: Callable) -> None:
+        self._routes[(method.upper(), path)] = handler
+
+    def start(self) -> "JsonHTTPServer":
+        if self._server is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+            def do_GET(self):
+                outer._handle(self, "GET")
+
+            def do_POST(self):
+                outer._handle(self, "POST")
+
+        try:
+            self._server = ThreadingHTTPServer(
+                (self._host, self._requested_port), Handler)
+        except OSError:
+            self._server = ThreadingHTTPServer((self._host, 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         name=self._name, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @staticmethod
+    def _query(request: BaseHTTPRequestHandler) -> dict[str, str]:
+        _, _, raw = request.path.partition("?")
+        query: dict[str, str] = {}
+        for pair in raw.split("&"):
+            if not pair:
+                continue
+            key, _, value = pair.partition("=")
+            query[key] = value
+        return query
+
+    def _handle(self, request: BaseHTTPRequestHandler, method: str) -> None:
+        path = request.path.split("?", 1)[0].rstrip("/") or "/"
+        handler = self._routes.get((method, path))
+        if handler is None:
+            code, body, ctype = 404, b'{"error": "unknown path"}', \
+                "application/json"
+        else:
+            length = int(request.headers.get("Content-Length") or 0)
+            payload = request.rfile.read(length) if length else b""
+            try:
+                result = handler(self._query(request), payload)
+            except Exception as e:  # noqa: BLE001 — a route must not kill the server
+                result = (500, {"error": f"{type(e).__name__}: {e}"[:300]})
+            if len(result) == 3:
+                code, body, ctype = result
+            else:
+                code, obj = result
+                body, ctype = json.dumps(obj).encode(), "application/json"
+        request.send_response(code)
+        request.send_header("Content-Type", ctype)
+        request.send_header("Content-Length", str(len(body)))
+        request.end_headers()
+        request.wfile.write(body)
 
 
 class RunMonitor:
@@ -90,9 +190,8 @@ class RunMonitor:
         # live monitor also answers "how does this run compare to the
         # last ones" — set by the engine when the ledger is enabled
         self._ledger = None
-        self._server: ThreadingHTTPServer | None = None
+        self._server: JsonHTTPServer | None = None
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
         self.port: int | None = None
 
     # ------------------------------------------------------------------
@@ -100,43 +199,30 @@ class RunMonitor:
     # ------------------------------------------------------------------
 
     def start(self) -> "RunMonitor":
-        """Bind the health server (idempotent) and start the watchdog."""
+        """Bind the health server (idempotent) and start the watchdog.
+        A fixed port that is already taken (another run's monitor?) falls
+        back to an ephemeral one — an observability thread must never
+        kill the run it observes; the ACTUAL port lands in ``self.port``,
+        the startup banner and the run_header."""
         if self._server is not None:
             return self
-        monitor = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):  # silence per-request stderr spam
-                pass
-
-            def do_GET(self):
-                monitor._handle(self)
-
-        try:
-            self._server = ThreadingHTTPServer(
-                (self._host, self._requested_port), Handler)
-        except OSError:
-            # fixed port taken (another run's monitor?) — an observability
-            # thread must never kill the run it observes; fall back to an
-            # ephemeral port, reported via self.port / the startup banner
-            self._server = ThreadingHTTPServer((self._host, 0), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        serve = threading.Thread(target=self._server.serve_forever,
-                                 name="attackfl-monitor-http", daemon=True)
-        watchdog = threading.Thread(target=self._watchdog_loop,
-                                    name="attackfl-monitor-watchdog",
-                                    daemon=True)
-        self._threads = [serve, watchdog]
-        serve.start()
-        watchdog.start()
+        self._server = JsonHTTPServer(self._host, self._requested_port,
+                                      name="attackfl-monitor-http")
+        self._server.route("GET", "/healthz", self._route_healthz)
+        self._server.route("GET", "/metrics", self._route_metrics)
+        self._server.route("GET", "/last-round", self._route_last_round)
+        self._server.route("GET", "/runs", self._route_runs)
+        self._server.start()
+        self.port = self._server.port
+        threading.Thread(target=self._watchdog_loop,
+                         name="attackfl-monitor-watchdog",
+                         daemon=True).start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
+            self._server.stop()
             self._server = None
 
     def run_started(self) -> None:
@@ -343,32 +429,21 @@ class RunMonitor:
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------------
-    # http plumbing
+    # http routes (JsonHTTPServer handlers)
     # ------------------------------------------------------------------
 
-    def _handle(self, request: BaseHTTPRequestHandler) -> None:
-        path = request.path.split("?", 1)[0].rstrip("/") or "/"
-        if path == "/healthz":
-            code, payload = self.health()
-            body = json.dumps(payload).encode()
-            ctype = "application/json"
-        elif path == "/metrics":
-            code, body, ctype = 200, self.metrics_text().encode(), \
-                "text/plain; version=0.0.4"
-        elif path == "/last-round":
-            code, body, ctype = 200, json.dumps(self.last_round()).encode(), \
-                "application/json"
-        elif path == "/runs":
-            code, body, ctype = 200, json.dumps(self.runs()).encode(), \
-                "application/json"
-        else:
-            code, body, ctype = 404, b'{"error": "unknown path"}', \
-                "application/json"
-        request.send_response(code)
-        request.send_header("Content-Type", ctype)
-        request.send_header("Content-Length", str(len(body)))
-        request.end_headers()
-        request.wfile.write(body)
+    def _route_healthz(self, query, body):
+        return self.health()
+
+    def _route_metrics(self, query, body):
+        return 200, self.metrics_text().encode(), \
+            "text/plain; version=0.0.4"
+
+    def _route_last_round(self, query, body):
+        return 200, self.last_round()
+
+    def _route_runs(self, query, body):
+        return 200, self.runs()
 
 
 def _is_plain(value: Any) -> bool:

@@ -742,6 +742,12 @@ class Simulator:
         if self._header_emitted or not tel.enabled:
             return
         self._header_emitted = True
+        # bind the live monitor BEFORE the header goes out so the header
+        # can record the ACTUAL port (`monitor-port: 0` binds ephemeral —
+        # parallel tests and multi-tenant services never collide, and
+        # tooling reading the run directory still finds the endpoint)
+        if self.monitor is not None:
+            self._start_monitor()
         programs = {}
         for name, fn in (("round_step", getattr(self, "_round_step_raw", None)),
                          ("aggregate", getattr(self, "_aggregate_raw", None)),
@@ -786,6 +792,11 @@ class Simulator:
             compile_cache_dir=self._compile_cache_dir or "",
             fault_plan=[spec.describe() for spec in self.cfg.faults],
             config=dataclasses.asdict(self.cfg),
+            # schema v6: the monitor's ACTUAL bound port (ephemeral under
+            # `monitor-port: 0`), absent when no monitor runs
+            **({"monitor_port": int(self.monitor.port)}
+               if self.monitor is not None and self.monitor.port is not None
+               else {}),
         )
         if self._resume_info is not None:
             # exactly-once round accounting: the resumed run declares the
@@ -1758,6 +1769,7 @@ class Simulator:
         save_checkpoints: bool = True,
         verbose: bool = True,
         progress: dict[str, Any] | None = None,
+        stop: Callable[[int], bool] | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
         """Like :meth:`run` but on the fused scan path: one device dispatch
         per chunk instead of several per round.  Checkpoints land per chunk
@@ -1768,6 +1780,10 @@ class Simulator:
         ``ok_rounds`` and ``interim_rounds_per_sec_incl_compile`` so a
         watchdog (bench --deadline) can report best-so-far throughput if a
         later dispatch wedges.
+
+        ``stop`` (see :meth:`run`) is consulted between CHUNKS — the
+        chunk is one opaque device dispatch, so that is the finest
+        graceful-drain granularity this path has.
 
         Unlike :meth:`run`, the passed-in ``state``'s buffers are DONATED to
         the device program — do not reuse it after this call.
@@ -1789,6 +1805,8 @@ class Simulator:
         self._start_monitor()
         try:
             while int(state["completed_rounds"]) < num_rounds:
+                if stop is not None and stop(int(state["completed_rounds"])):
+                    break
                 remaining = num_rounds - int(state["completed_rounds"])
                 # Chunk sizing doubles as a compile-cache policy: the first
                 # dispatch compiles one bounded-length scan (a 100-round run
@@ -1971,6 +1989,7 @@ class Simulator:
         state: dict[str, Any],
         save_checkpoints: bool,
         verbose: bool,
+        stop: Callable[[int], bool] | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
         """Depth-1 software-pipelined round loop (``cfg.pipeline``).
 
@@ -2026,9 +2045,15 @@ class Simulator:
 
         try:
             while completed < num_rounds or pending is not None:
+                # graceful-drain seam: once the hook says stop, dispatch
+                # no new rounds; in-flight ones still resolve (and
+                # checkpoint) below, then the loop exits quiesced
+                stopping = stop is not None and stop(completed)
+                if stopping and pending is None:
+                    break
                 new_pending: dict[str, Any] | None = None
                 want_more = (completed + (1 if pending is not None else 0)
-                             < num_rounds)
+                             < num_rounds) and not stopping
                 # demoted: no overlap — never dispatch past an unresolved
                 # round (depth-0); healthy: depth-1 dispatch-then-resolve
                 if want_more and (pending is None or not degraded):
@@ -2164,6 +2189,7 @@ class Simulator:
         save_checkpoints: bool = True,
         verbose: bool = True,
         pipeline: bool | None = None,
+        stop: Callable[[int], bool] | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
         """Run until ``num_rounds`` rounds complete (reference main loop,
         server.py:559-567).
@@ -2174,7 +2200,16 @@ class Simulator:
         path, with round N+1 dispatched before round N's flag is
         materialized.  Host-side-defense modes (gmm / fltracer,
         hyper-detection, reload-per-round) fall back to the synchronous
-        loop with a warning."""
+        loop with a warning.
+
+        ``stop``, if given, is consulted between rounds with the current
+        completed-round count: returning True ends the run at the next
+        round boundary — the in-flight round finishes, its checkpoint is
+        saved, and ``_finish_run`` drains as usual.  This is the run
+        service's graceful-drain seam (SIGTERM → finish the round →
+        checkpoint → requeue) and its ``worker_death`` injection point
+        (the hook may raise; the exception takes the normal crash path
+        through the ``finally`` drains)."""
         cfg = self.cfg
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
         state = self._ensure_numerics_state(
@@ -2184,7 +2219,8 @@ class Simulator:
         if use_pipeline:
             if self.supports_fused():
                 return self._run_pipelined(num_rounds, state,
-                                           save_checkpoints, verbose)
+                                           save_checkpoints, verbose,
+                                           stop=stop)
             print_with_color(
                 f"[pipeline] mode '{cfg.mode}' needs host-side per-round "
                 "work; falling back to the synchronous path.", "yellow")
@@ -2196,6 +2232,8 @@ class Simulator:
         self._start_monitor()
         try:
             while int(state["completed_rounds"]) < num_rounds:
+                if stop is not None and stop(int(state["completed_rounds"])):
+                    break
                 round_no = int(state["completed_rounds"]) + 1
                 if verbose:
                     print_with_color(f"Start training round {round_no}", "yellow")

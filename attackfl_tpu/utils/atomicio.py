@@ -1,0 +1,129 @@
+"""Durable-file primitives shared by every persistence layer.
+
+One module, one discipline: the checkpoint manager, the ledger store and
+the run-service job queue all publish files the same way — write a temp,
+``fsync`` it, ``os.replace`` onto the final name — so a kill -9 at any
+instant leaves either the old complete file or the new complete one,
+never a half-written hybrid.  Factored out of ``utils/checkpoint.py``
+(which re-uses :func:`write_bytes_atomic` / :func:`content_hash`) so the
+jax-free layers (ledger CLI, service queue, job client) get the identical
+behavior without importing jax.
+
+Two additions the service layer (ISSUE 8) needs:
+
+* **sealed JSON** — :func:`write_sealed_json` embeds a sha256 of the
+  canonical payload next to the payload itself; :func:`read_sealed_json`
+  verifies it.  The rename publish is already atomic, but a fault-
+  injected tear (``queue_torn``) or a foreign truncation must be
+  *detected*, not deserialized into garbage — the same contract the
+  checkpoint manifest keeps per entry.
+* **advisory file locks** — :func:`file_lock` wraps ``fcntl.flock`` on a
+  sidecar ``.lock`` file so N service workers (separate store instances,
+  possibly separate processes) can append to one ledger without
+  interleaving the JSONL append with the index republish.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+from typing import Any
+
+try:  # POSIX advisory locks; the service targets linux boxes
+    import fcntl
+except ImportError:  # pragma: no cover — non-POSIX fallback is lock-free
+    fcntl = None
+
+SEAL_VERSION = 1
+
+
+def content_hash(data: bytes) -> str:
+    """The manifest/seal content-hash contract (hex sha256)."""
+    return hashlib.sha256(data).hexdigest()
+
+
+def write_bytes_atomic(path: str, data: bytes, tmp_suffix: str = ".tmp") -> None:
+    """Durable atomic publish: write a temp file, fsync it, rename.  A
+    failure mid-write unlinks its own temp so crashes can't accumulate
+    orphans (each layer's startup orphan sweep catches hard kills)."""
+    tmp = path + tmp_suffix
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_json_atomic(path: str, payload: Any, tmp_suffix: str = ".tmp") -> None:
+    """JSON convenience over :func:`write_bytes_atomic` (the ledger
+    index / service discovery publish path)."""
+    write_bytes_atomic(path, (json.dumps(payload) + "\n").encode(),
+                       tmp_suffix=tmp_suffix)
+
+
+def _canonical(payload: Any) -> bytes:
+    return json.dumps(payload, sort_keys=True).encode()
+
+
+def write_sealed_json(path: str, payload: Any,
+                      tmp_suffix: str = ".tmp") -> None:
+    """Publish ``payload`` wrapped in a content-hash seal: readers can
+    tell a complete entry from a torn/tampered one without trusting the
+    filesystem (``read_sealed_json`` is the verifying counterpart)."""
+    wrapper = {"seal": SEAL_VERSION,
+               "sha256": content_hash(_canonical(payload)),
+               "payload": payload}
+    write_bytes_atomic(path, (json.dumps(wrapper) + "\n").encode(),
+                       tmp_suffix=tmp_suffix)
+
+
+def read_sealed_json(path: str) -> tuple[Any | None, str | None]:
+    """Load a sealed entry.  Returns ``(payload, None)`` when the seal
+    verifies, ``(None, reason)`` when the file is missing, torn (JSON cut
+    off), or its recorded hash no longer matches the payload."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError as e:
+        return None, f"unreadable: {e}"
+    try:
+        wrapper = json.loads(data.decode("utf-8", errors="replace"))
+    except ValueError as e:
+        return None, f"torn/not JSON: {e}"
+    if not isinstance(wrapper, dict) or "payload" not in wrapper:
+        return None, "not a sealed entry"
+    payload = wrapper["payload"]
+    if wrapper.get("sha256") != content_hash(_canonical(payload)):
+        return None, "content hash mismatch"
+    return payload, None
+
+
+@contextlib.contextmanager
+def file_lock(path: str):
+    """Advisory exclusive lock on ``path`` (created on demand).  Blocks
+    until acquired; released on exit.  ``fcntl.flock`` locks the open
+    file description, so two handles in ONE process exclude each other
+    exactly like two processes do — which is what the multi-writer
+    ledger test relies on.  On platforms without fcntl this degrades to
+    a no-op (single-writer deployments keep working)."""
+    if fcntl is None:  # pragma: no cover
+        yield
+        return
+    fh = open(path, "a+")
+    try:
+        fcntl.flock(fh.fileno(), fcntl.LOCK_EX)
+        yield
+    finally:
+        try:
+            fcntl.flock(fh.fileno(), fcntl.LOCK_UN)
+        finally:
+            fh.close()

@@ -33,7 +33,6 @@ ISSUE 6 adds the durability layer around that contract:
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import json
 import os
 import threading
@@ -44,6 +43,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import serialization
+
+# the durable-publish primitives live in the jax-free utils/atomicio
+# module (shared with the ledger store and the run-service job queue);
+# re-exported here for the tests that always imported them from this
+# module
+from attackfl_tpu.utils.atomicio import content_hash  # noqa: F401
+from attackfl_tpu.utils.atomicio import write_bytes_atomic as _write_bytes
 
 # fingerprinting lives in the jax-free utils/fingerprint module (the
 # ledger CLI needs it without a jax import); re-exported here for the
@@ -75,32 +81,8 @@ def host_state(state: Any) -> Any:
     return jax.device_get(_strip_keys(state))
 
 
-def _write_bytes(path: str, data: bytes, tmp_suffix: str = ".tmp") -> None:
-    """Durable atomic publish: write a temp file, fsync it, rename.  A
-    failure mid-write unlinks its own temp so crashes can't accumulate
-    orphans (the startup :func:`sweep_orphans` catches hard kills)."""
-    tmp = path + tmp_suffix
-    try:
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
 def save_state(path: str, state: Any) -> None:
     _write_bytes(path, serialization.to_bytes(host_state(state)))
-
-
-def content_hash(data: bytes) -> str:
-    """The manifest's content-hash contract (hex sha256)."""
-    return hashlib.sha256(data).hexdigest()
 
 
 def sweep_orphans(directory: str) -> list[str]:

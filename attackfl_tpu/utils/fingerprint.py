@@ -28,7 +28,7 @@ FINGERPRINT_VOLATILE = frozenset({
     "num_round", "load_parameters", "resume", "faults", "checkpoint_async",
     "checkpoint_keep", "pipeline", "pipeline_demote_after",
     "pipeline_repromote_after", "validation_every", "validation_async",
-    "reload_parameters_per_round",
+    "reload_parameters_per_round", "service",
 })
 
 

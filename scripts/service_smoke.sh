@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# One-shot run-service smoke gate (ISSUE 8 satellite), mirroring
+# scripts/audit.sh / scripts/regress.sh: boots a REAL `attackfl-tpu
+# serve` daemon (its own process, ephemeral port), submits a tiny job
+# through the jax-free client, waits for completion, asserts the shared
+# ledger holds the run's record, then drains the daemon with SIGTERM and
+# expects a clean exit 0 — the full submit → complete → ledger → drain
+# lifecycle in one script.  Used by tier-1 through tests/test_service.py;
+# run it directly before sending a PR.
+#
+# Usage: scripts/service_smoke.sh [spool-dir]   (default: a fresh tmp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# share the persistent compile cache so repeat smokes skip the compile
+export ATTACKFL_COMPILE_CACHE="${ATTACKFL_COMPILE_CACHE:-/tmp/attackfl_jax_cache}"
+
+SPOOL="${1:-$(mktemp -d /tmp/attackfl_service_smoke.XXXXXX)}"
+CFG="$SPOOL/job.yaml"
+cat > "$CFG" <<'YAML'
+server:
+  num-round: 1
+  clients: 3
+  mode: fedavg
+  model: CNNModel
+  data-name: ICU
+  validation: false
+  train-size: 256
+  test-size: 128
+  random-seed: 1
+  data-distribution:
+    num-data-range: [48, 64]
+learning:
+  epoch: 1
+  batch-size: 32
+YAML
+
+python -m attackfl_tpu serve --spool "$SPOOL" --port 0 \
+    --worker-backoff 0.2 &
+SERVE_PID=$!
+cleanup() { kill -9 "$SERVE_PID" 2>/dev/null || true; }
+trap cleanup EXIT
+
+echo "--- waiting for the control plane (spool: $SPOOL)"
+for _ in $(seq 1 150); do
+    [ -f "$SPOOL/service.json" ] && break
+    sleep 0.2
+done
+[ -f "$SPOOL/service.json" ] || { echo "service never came up" >&2; exit 1; }
+
+echo "--- submit -> wait (jax-free client)"
+JOB=$(python -m attackfl_tpu job submit --spool "$SPOOL" --config "$CFG" \
+      --name smoke)
+echo "job: $JOB"
+python -m attackfl_tpu job wait "$JOB" --spool "$SPOOL" --timeout 300
+
+echo "--- ledger record present"
+python - "$SPOOL" <<'PY'
+import sys
+from attackfl_tpu.ledger.store import LedgerStore
+
+entries = LedgerStore(sys.argv[1] + "/ledger").index()
+assert entries, "no ledger record for the completed job"
+print(f"ledger records: {len(entries)} (newest: {entries[-1]['record_id']})")
+PY
+
+echo "--- SIGTERM drain -> clean exit"
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+trap - EXIT
+echo "service smoke: OK"

@@ -101,7 +101,7 @@ def test_sync_run_appends_record_with_attribution(run_dir, tmp_path):
     assert ledger_events[0]["record_id"] == record["record_id"]
     # run_header carries the v5 provenance fields
     header = next(e for e in events if e["kind"] == "run_header")
-    assert header["schema"] == 5
+    assert header["schema"] >= 5  # v6 (ISSUE 8) added the service kinds
     assert isinstance(header["jaxlib_version"], str)
     assert header["platform"] == "cpu"
     assert isinstance(header["git_rev"], str)
@@ -416,7 +416,7 @@ def test_v5_kinds_registered_and_older_schemas_unchanged():
         KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
     )
 
-    assert SCHEMA_VERSION == 5
+    assert SCHEMA_VERSION >= 5  # v6 (ISSUE 8) added the service kinds
     assert KINDS_BY_VERSION[5] == frozenset({"ledger"})
     assert "ledger" not in known_kinds(4)
     assert "ledger" in known_kinds(5)

@@ -1,0 +1,784 @@
+"""Resilient run service (ISSUE 8): queue durability, admission control,
+worker supervision, graceful drain, kill -9 crash recovery (bit-identical
+through a torn queue entry), the HTTP control plane, the schema-v6 event
+kinds, and the satellites (watch backoff, ledger multi-writer lock,
+run_header monitor_port, service smoke script).
+"""
+
+import http.client
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from attackfl_tpu.config import Config, config_from_dict
+from attackfl_tpu.faults.plan import parse_fault_plan
+from attackfl_tpu.service.daemon import RunService
+from attackfl_tpu.service.queue import JobQueue, QueueFullError
+from attackfl_tpu.service.worker import backoff_delay, build_job_config
+from attackfl_tpu.utils.atomicio import (
+    read_sealed_json, write_sealed_json,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# the chaos-suite shapes (tests/test_faults.py BASE): programs are warm
+# in the shared persistent compile cache by the time this module runs
+JOB_CONFIG = {
+    "server": {
+        "num-round": 2, "clients": 3, "mode": "fedavg", "model": "CNNModel",
+        "data-name": "ICU", "validation": False, "train-size": 256,
+        "test-size": 128, "random-seed": 1,
+        "data-distribution": {"num-data-range": [48, 64]},
+    },
+    "learning": {"epoch": 1, "batch-size": 32},
+}
+
+
+def job_config(**server_overrides):
+    raw = json.loads(json.dumps(JOB_CONFIG))  # deep copy
+    raw["server"].update(server_overrides)
+    return raw
+
+
+def make_service(tmp_path, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("worker_backoff", 0.01)
+    kw.setdefault("worker_backoff_cap", 0.05)
+    return RunService(str(tmp_path / "spool"), **kw)
+
+
+def wait_for(predicate, timeout=120.0, interval=0.05, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+_REFERENCE_CACHE: dict[str, bytes] = {}
+
+
+def reference_run(tmp_path, raw_config, num_rounds=None):
+    """One uninterrupted in-process run of the same job config; returns
+    the final checkpoint bytes (the bit-identicality yardstick).
+    Memoized per config — several tests compare against the same
+    trajectory, and the reference is deterministic by construction."""
+    from attackfl_tpu.training.engine import Simulator
+
+    key = json.dumps([raw_config, num_rounds], sort_keys=True)
+    cached = _REFERENCE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    ref_dir = tmp_path / "reference"
+    ref_dir.mkdir(exist_ok=True)
+    cfg = config_from_dict(raw_config).replace(
+        log_path=str(ref_dir), checkpoint_dir=str(ref_dir))
+    sim = Simulator(cfg)
+    sim.run(num_rounds=num_rounds, verbose=False)
+    sim.close()
+    data = (ref_dir / "CNNModel.msgpack").read_bytes()
+    _REFERENCE_CACHE[key] = data
+    return data
+
+
+def job_checkpoint_bytes(service, job_id):
+    return (pathlib.Path(service.spool) / "jobs" / job_id
+            / "CNNModel.msgpack").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# durable queue: sealed entries, admission, replay
+# ---------------------------------------------------------------------------
+
+def test_queue_submit_is_durable_and_sealed(tmp_path):
+    queue = JobQueue(str(tmp_path / "q"), depth=4)
+    jid = queue.submit({"config": {"x": 1}, "name": "a"})
+    spec, reason = read_sealed_json(str(tmp_path / "q" / f"{jid}.json"))
+    assert reason is None and spec["name"] == "a" and spec["seq"] == 1
+    status, reason = read_sealed_json(
+        str(tmp_path / "q" / f"{jid}.status.json"))
+    assert reason is None and status["state"] == "queued"
+    # claim -> running -> done round-trips through the spool
+    job = queue.claim()
+    assert job.job_id == jid and queue.get(jid).state == "running"
+    queue.mark(jid, "done", result={"ok_rounds": 2})
+    assert queue.get(jid).state == "done"
+    assert queue.claim() is None  # nothing left to claim
+
+
+def test_queue_admission_control_rejects_explicitly(tmp_path):
+    queue = JobQueue(str(tmp_path / "q"), depth=2)
+    queue.submit({"name": "a"})
+    queue.submit({"name": "b"})
+    with pytest.raises(QueueFullError, match="queue full"):
+        queue.submit({"name": "c"})
+    # a terminal job frees its slot
+    done = queue.claim()
+    queue.mark(done.job_id, "done")
+    queue.submit({"name": "c"})
+
+
+def test_queue_cancel_only_touches_queued(tmp_path):
+    queue = JobQueue(str(tmp_path / "q"), depth=4)
+    jid = queue.submit({"name": "a"})
+    running = queue.submit({"name": "b"})
+    queue.claim()  # jid -> running (oldest first)
+    assert queue.cancel(jid) == "running"
+    assert queue.cancel(running) == "cancelled"
+    assert queue.cancel("nope") == "not_found"
+
+
+def test_queue_replay_requeues_interrupted_and_torn(tmp_path):
+    qdir = tmp_path / "q"
+    queue = JobQueue(str(qdir), depth=8)
+    interrupted = queue.submit({"name": "interrupted"})
+    torn = queue.submit({"name": "torn"})
+    done = queue.submit({"name": "done"})
+    queue.claim()  # interrupted -> running (daemon "dies" here)
+    queue.mark(done, "done")
+    # tear the second job's status entry (kill -9 mid-publish analog)
+    status_path = qdir / f"{torn}.status.json"
+    status_path.write_bytes(status_path.read_bytes()[: status_path.stat()
+                                                     .st_size // 2])
+    fresh = JobQueue(str(qdir), depth=8)
+    replay = fresh.replay()
+    assert set(replay["requeued"]) == {interrupted, torn}
+    assert len(replay["torn"]) == 1
+    by_id = {j.job_id: j for j in fresh.jobs()}
+    assert by_id[interrupted].state == "queued"
+    assert by_id[interrupted].status["resume"] is True
+    assert by_id[torn].status["resume"] is True
+    assert by_id[done].state == "done"  # untouched
+
+
+def test_queue_torn_spec_is_quarantined_not_trusted(tmp_path):
+    qdir = tmp_path / "q"
+    queue = JobQueue(str(qdir), depth=8)
+    jid = queue.submit({"name": "a"})
+    spec_path = qdir / f"{jid}.json"
+    spec_path.write_bytes(spec_path.read_bytes()[:10])
+    fresh = JobQueue(str(qdir), depth=8)
+    assert fresh.jobs() == []
+    assert (qdir / f"{jid}.json.torn").exists()
+    assert fresh.torn_entries and "torn" in fresh.torn_entries[0]["reason"]
+
+
+def test_sealed_json_detects_tamper(tmp_path):
+    path = str(tmp_path / "entry.json")
+    write_sealed_json(path, {"a": 1})
+    payload, reason = read_sealed_json(path)
+    assert payload == {"a": 1} and reason is None
+    # flip the payload without re-sealing
+    raw = json.loads(open(path).read())
+    raw["payload"]["a"] = 2
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    payload, reason = read_sealed_json(path)
+    assert payload is None and reason == "content hash mismatch"
+
+
+# ---------------------------------------------------------------------------
+# service fault kinds: submit_flood + queue_torn through the plan grammar
+# ---------------------------------------------------------------------------
+
+def test_submit_flood_fault_exercises_admission(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.faults.inject import HostFaultInjector
+    from attackfl_tpu.telemetry import Counters, EventLog, NullTracer, Telemetry
+
+    tel = Telemetry(EventLog(str(tmp_path / "service.events.jsonl")),
+                    NullTracer(), Counters(), True)
+    injector = HostFaultInjector(
+        parse_fault_plan("submit_flood@1:count=5"), tel)
+    queue = JobQueue(str(tmp_path / "q"), depth=3, telemetry=tel,
+                     injector=injector)
+    queue.submit({"name": "real"})
+    jobs = queue.jobs()
+    assert len(jobs) == 3  # the real job + 2 admitted flood duplicates
+    assert tel.counters.get("jobs_rejected") == 3  # the overflow, explicit
+    events = [json.loads(line)
+              for line in open(tmp_path / "service.events.jsonl")]
+    assert [e["fault"] for e in events if e["kind"] == "fault"] \
+        == ["submit_flood"]
+    rejected = [e for e in events
+                if e["kind"] == "job" and e["action"] == "rejected"]
+    assert len(rejected) == 3 and all("queue full" in e["reason"]
+                                      for e in rejected)
+
+
+def test_queue_torn_fault_tears_a_status_publish(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.faults.inject import HostFaultInjector
+    from attackfl_tpu.telemetry import Counters, EventLog, NullTracer, Telemetry
+
+    tel = Telemetry(EventLog(str(tmp_path / "service.events.jsonl")),
+                    NullTracer(), Counters(), True)
+    injector = HostFaultInjector(parse_fault_plan("queue_torn@2"), tel)
+    queue = JobQueue(str(tmp_path / "q"), depth=4, telemetry=tel,
+                     injector=injector)
+    a = queue.submit({"name": "a"})  # publish 1 (a: queued)
+    b = queue.submit({"name": "b"})  # publish 2 (b: queued) — TORN
+    payload, reason = read_sealed_json(
+        str(tmp_path / "q" / f"{b}.status.json"))
+    assert payload is None and reason  # the tear is detectable
+    fresh = JobQueue(str(tmp_path / "q"), depth=4)
+    replay = fresh.replay()
+    assert replay["requeued"] == [b]  # recovered, resume=True
+    assert {j.job_id: j.state for j in fresh.jobs()} \
+        == {a: "queued", b: "queued"}
+
+
+# ---------------------------------------------------------------------------
+# worker supervision: crash -> backoff restarts -> resume; budget -> failed
+# ---------------------------------------------------------------------------
+
+def test_backoff_delay_is_bounded_exponential():
+    delays = [backoff_delay(n, 0.5, 30.0) for n in range(1, 9)]
+    assert delays[:4] == [0.5, 1.0, 2.0, 4.0]
+    assert delays[-1] == 30.0  # capped
+
+
+def test_build_job_config_enforces_isolation(tmp_path):
+    """The submitter cannot opt out of isolation: paths, telemetry
+    files, the shared ledger and the resume flag are the SERVICE's
+    choice, whatever the spec's config says."""
+    spec = {"config": dict(job_config(), log_path="/somewhere/else"),
+            "num_rounds": 2}
+    cfg = build_job_config(spec, str(tmp_path / "job"),
+                           str(tmp_path / "ledger"), resume=True,
+                           run_monitor=True)
+    assert cfg.log_path == str(tmp_path / "job")
+    assert cfg.checkpoint_dir == str(tmp_path / "job")
+    assert cfg.telemetry.events_path == str(tmp_path / "job" / "events.jsonl")
+    assert cfg.telemetry.ledger_dir == str(tmp_path / "ledger")
+    assert cfg.telemetry.monitor is True and cfg.telemetry.monitor_port == 0
+    assert cfg.resume is True
+
+
+def test_worker_death_restarts_and_resumes_bit_identical(tmp_path):
+    """The ``worker_death`` kind: the worker crashes after round 1, the
+    supervisor backs off, restarts it with resume semantics, and the job
+    still finishes with final params bit-identical to an uninterrupted
+    run — the whole recovery path driven by the fault plan."""
+    service = make_service(
+        tmp_path, fault_plan=parse_fault_plan("worker_death@1"))
+    service.start()
+    try:
+        jid = service.submit({"config": job_config(), "name": "crashy"})
+        job = wait_for(
+            lambda: (lambda j: j if j and j.state in
+                     ("done", "failed", "cancelled") else None)(
+                         service.queue.get(jid)),
+            timeout=180, message="job terminal state")
+        assert job.state == "done"
+        assert job.status["attempts"] == 1  # exactly one supervised restart
+        events = [json.loads(line) for line in
+                  open(os.path.join(service.spool, "service.events.jsonl"))]
+        assert [e["fault"] for e in events if e["kind"] == "fault"] \
+            == ["worker_death"]
+        retried = [e for e in events
+                   if e["kind"] == "job" and e["action"] == "retried"]
+        assert len(retried) == 1 and retried[0]["backoff_seconds"] > 0
+        # the resumed attempt really resumed (a `resume` event in the
+        # job's own telemetry) and converged bit-identical
+        job_events = [json.loads(line) for line in
+                      open(os.path.join(service.spool, "jobs", jid,
+                                        "events.jsonl"))]
+        assert any(e["kind"] == "resume" for e in job_events)
+        assert job_checkpoint_bytes(service, jid) \
+            == reference_run(tmp_path, job_config())
+    finally:
+        service.drain(timeout=10)
+        service.close()
+
+
+def test_worker_retry_budget_marks_failed_service_survives(tmp_path):
+    """A job that crashes past its retry budget is marked failed — and
+    the service keeps serving: the next submission still completes."""
+    service = make_service(tmp_path, worker_retries=1)
+    service.start()
+    try:
+        bad = service.submit(
+            {"config": {"server": {"model": "NoSuchModel"}}, "name": "bad"})
+        job = wait_for(
+            lambda: (lambda j: j if j.state == "failed" else None)(
+                service.queue.get(bad)),
+            timeout=60, message="bad job failed")
+        assert job.status["attempts"] == 2  # initial + 1 supervised restart
+        assert "NoSuchModel" in job.status["error"]
+        good = service.submit(
+            {"config": job_config(**{"num-round": 1}), "name": "good"})
+        wait_for(lambda: service.queue.get(good).state == "done",
+                 timeout=180, message="good job done")
+    finally:
+        service.drain(timeout=10)
+        service.close()
+
+
+def test_drain_requeues_and_next_daemon_completes(tmp_path):
+    """Graceful drain: SIGTERM semantics in-process — the in-flight
+    round finishes, the job requeues with resume, a NEW service on the
+    same spool finishes it, final params bit-identical."""
+    raw = job_config(**{"num-round": 8})
+    service = make_service(tmp_path)
+    service.start()
+    jid = service.submit({"config": raw, "name": "drainee"})
+    manifest = pathlib.Path(service.spool) / "jobs" / jid / "manifest.json"
+    wait_for(manifest.exists, timeout=120, message="first checkpoint")
+    assert service.drain(timeout=60) is True
+    job = service.queue.get(jid)
+    assert job.state == "queued" and job.status["resume"] is True
+    completed = job.status["completed"]
+    assert 1 <= completed < 8  # stopped at a round boundary, mid-job
+    service.close()
+
+    second = make_service(tmp_path)
+    second.start()
+    try:
+        wait_for(lambda: second.queue.get(jid).state == "done",
+                 timeout=180, message="resumed job done")
+        assert job_checkpoint_bytes(second, jid) \
+            == reference_run(tmp_path, raw)
+    finally:
+        second.drain(timeout=10)
+        second.close()
+
+
+# ---------------------------------------------------------------------------
+# control plane: health aggregation + endpoints
+# ---------------------------------------------------------------------------
+
+class _StubWorker:
+    def __init__(self, job_id, status="ok", stalled=False):
+        self._payload = {"job_id": job_id, "status": status,
+                         "stalled": stalled}
+        self.job = type("J", (), {"job_id": job_id})()
+
+    def health(self):
+        return dict(self._payload)
+
+
+def test_healthz_aggregates_run_states(tmp_path):
+    service = make_service(tmp_path)
+    code, payload = service.health()
+    assert code == 200 and payload["status"] == "ok"
+    service._workers["a"] = _StubWorker("a", status="degraded")
+    code, payload = service.health()
+    assert code == 200 and payload["status"] == "degraded"
+    # one stalled run flips the SERVICE to 503 (no progress beats slow)
+    service._workers["b"] = _StubWorker("b", status="stalled", stalled=True)
+    code, payload = service.health()
+    assert code == 503 and payload["status"] == "stalled"
+    assert {r["job_id"] for r in payload["runs"]} == {"a", "b"}
+    service._workers.clear()
+    service.request_drain()
+    code, payload = service.health()
+    assert code == 200 and payload["status"] == "draining"
+    service.close()
+
+
+def test_http_control_plane_endpoints(tmp_path):
+    """submit/status/cancel/jobs/metrics over real HTTP (the dispatcher
+    is not started, so queue states are deterministic)."""
+    service = make_service(tmp_path, queue_depth=2)
+    service._http.start()  # control plane only, no dispatch
+    base = f"http://127.0.0.1:{service._http.port}"
+
+    def call(path, method="GET", body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode() or "{}")
+
+    code, payload = call("/submit", "POST", {"name": "one"})
+    assert code == 200
+    jid = payload["job_id"]
+    code, _ = call("/submit", "POST", {"name": "two"})
+    assert code == 200
+    # depth 2: the third submission is an explicit 429, not a drop
+    code, payload = call("/submit", "POST", {"name": "three"})
+    assert code == 429 and "queue full" in payload["error"]
+    code, payload = call("/jobs")
+    assert {j["state"] for j in payload["jobs"]} == {"queued"}
+    code, payload = call(f"/status?job={jid}")
+    assert code == 200 and payload["state"] == "queued"
+    code, payload = call("/status?job=nope")
+    assert code == 404
+    code, payload = call(f"/cancel?job={jid}", "POST")
+    assert code == 200 and payload["outcome"] == "cancelled"
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+        assert resp.status == 200
+        text = resp.read().decode()
+    assert 'attackfl_service_jobs{state="cancelled"} 1' in text
+    assert 'attackfl_counter{name="jobs_rejected"} 1' in text
+    code, payload = call("/healthz")
+    assert code == 200
+    service.close()
+
+
+def test_service_config_yaml_roundtrip():
+    import yaml
+
+    raw = yaml.safe_load("""
+service:
+  port: 0
+  max-workers: 3
+  queue-depth: 7
+  worker-retries: 5
+  worker-backoff: 0.25
+  run-monitors: false
+""")
+    cfg = config_from_dict(raw)
+    assert cfg.service.port == 0
+    assert cfg.service.max_workers == 3
+    assert cfg.service.queue_depth == 7
+    assert cfg.service.worker_retries == 5
+    assert cfg.service.run_monitors is False
+    with pytest.raises(ValueError, match="max_workers"):
+        config_from_dict({"service": {"max-workers": 0}})
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: kill -9 + torn queue entry -> bit-identical recovery
+# ---------------------------------------------------------------------------
+
+def _daemon_cmd(spool):
+    return [sys.executable, "-m", "attackfl_tpu", "serve", "--spool",
+            str(spool), "--port", "0", "--worker-backoff", "0.05"]
+
+
+def _daemon_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.setdefault("ATTACKFL_COMPILE_CACHE", "/tmp/attackfl_jax_cache")
+    return env
+
+
+def _wait_daemon(proc, spool, timeout=90):
+    """Wait for THIS daemon's discovery publish (a restart rewrites the
+    file with its own pid + fresh ephemeral port)."""
+    path = os.path.join(str(spool), "service.json")
+
+    def up():
+        try:
+            with open(path) as fh:
+                disc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        return disc["url"] if disc.get("pid") == proc.pid else None
+
+    return wait_for(up, timeout=timeout, message="daemon discovery")
+
+
+def _http(base, path, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_kill_dash_nine_recovery_bit_identical(tmp_path):
+    """THE chaos gate: a real daemon process is SIGKILLed mid-round with
+    1 running + 2 queued jobs and one queue entry torn post-mortem; the
+    restarted daemon replays the queue, resumes from the newest
+    hash-valid checkpoint, and all 3 jobs complete with final params
+    bit-identical to an uninterrupted run.  SIGTERM then drains it
+    cleanly (exit 0)."""
+    spool = tmp_path / "spool"
+    raw = job_config(**{"num-round": 3})
+    proc = subprocess.Popen(_daemon_cmd(spool), env=_daemon_env(),
+                            cwd=str(REPO), stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        base = _wait_daemon(proc, spool)
+        jobs = [_http(base, "/submit", "POST",
+                      {"config": raw, "name": f"j{i}"})["job_id"]
+                for i in range(3)]
+        # kill -9 once job 0 has a durable checkpoint (mid-run, rounds
+        # still outstanding; jobs 1-2 still queued under max_workers=1)
+        manifest = spool / "jobs" / jobs[0] / "manifest.json"
+        wait_for(manifest.exists, timeout=120, message="job 0 checkpoint")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        # tear a queued job's status entry — the restart must recover
+        # THROUGH the torn entry, not around it
+        status_path = spool / "queue" / f"{jobs[1]}.status.json"
+        status_path.write_bytes(
+            status_path.read_bytes()[: status_path.stat().st_size // 2])
+
+        proc = subprocess.Popen(_daemon_cmd(spool), env=_daemon_env(),
+                                cwd=str(REPO), stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        base = _wait_daemon(proc, spool)
+
+        def all_done():
+            states = {j["job_id"]: j["state"]
+                      for j in _http(base, "/jobs")["jobs"]}
+            bad = [j for j in jobs
+                   if states.get(j) in ("failed", "cancelled")]
+            assert not bad, f"job(s) {bad} terminal-failed: {states}"
+            return all(states.get(j) == "done" for j in jobs)
+
+        wait_for(all_done, timeout=300, interval=0.3,
+                 message="all 3 jobs done after restart")
+
+        # graceful drain: SIGTERM -> clean exit 0
+        os.kill(proc.pid, signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # bit-identical: every job's final checkpoint == one uninterrupted
+    # reference run (identical config/seed across the three jobs)
+    ref = reference_run(tmp_path, raw)
+    for jid in jobs:
+        assert (spool / "jobs" / jid
+                / "CNNModel.msgpack").read_bytes() == ref, jid
+
+    # the replay left honest evidence: requeues + the torn-entry count
+    events = [json.loads(line)
+              for line in open(spool / "service.events.jsonl")]
+    replayed = [e for e in events
+                if e["kind"] == "service" and e["action"] == "replayed"]
+    assert replayed and replayed[0]["torn_entries"] >= 1
+    requeue_reasons = {e["job_id"]: e["reason"] for e in events
+                       if e["kind"] == "job" and e["action"] == "requeued"}
+    assert requeue_reasons[jobs[0]] == "interrupted"
+    assert requeue_reasons[jobs[1]] == "status_torn"
+
+
+def test_service_smoke_script():
+    """scripts/service_smoke.sh — the tier-1 submit -> complete ->
+    ledger -> drain lifecycle against a real daemon."""
+    result = subprocess.run(
+        ["bash", str(REPO / "scripts" / "service_smoke.sh")],
+        cwd=str(REPO), env=_daemon_env(), capture_output=True, text=True,
+        timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "service smoke: OK" in result.stdout
+    assert "ledger records: 1" in result.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellite: ledger multi-writer safety (advisory file lock)
+# ---------------------------------------------------------------------------
+
+def test_ledger_concurrent_appends_from_separate_stores(tmp_path):
+    """N threads, each with its OWN LedgerStore instance over one
+    directory (the N-service-workers topology): every append lands, the
+    index agrees with the JSONL, and collision suffixes stay unique —
+    the advisory file lock makes the append+republish atomic across
+    instances."""
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    directory = str(tmp_path / "ledger")
+    stores = [LedgerStore(directory) for _ in range(4)]
+    errors = []
+
+    def writer(store, tag):
+        try:
+            for i in range(6):
+                store.append({"run_id": "collide",  # force suffix races
+                              "ts": 0.0, "executor": "sync",
+                              "source": f"{tag}-{i}"})
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(s, t))
+               for t, s in enumerate(stores)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    fresh = LedgerStore(directory)
+    records, skipped = fresh.load()
+    assert skipped == 0 and len(records) == 24
+    ids = [r["record_id"] for r in records]
+    assert len(set(ids)) == 24  # every collision got a unique suffix
+    index = fresh.index()
+    assert len(index) == 24
+    assert [e["record_id"] for e in index] == ids  # index == JSONL truth
+
+
+# ---------------------------------------------------------------------------
+# satellite: watch survives service restarts with capped backoff
+# ---------------------------------------------------------------------------
+
+def test_watch_backoff_schedule():
+    from attackfl_tpu.cli import _watch_backoff
+
+    assert [_watch_backoff(n, 5.0) for n in (1, 2, 3, 4, 5)] \
+        == [5.0, 10.0, 20.0, 40.0, 60.0]  # doubles, capped at 60
+    assert _watch_backoff(50, 5.0, cap=7.5) == 7.5
+
+
+def test_watch_retries_through_connection_errors(monkeypatch, capsys):
+    """Connection refused AND an http.client-level reset (the class that
+    used to crash the poller) are both survived; the backoff doubles per
+    consecutive failure and resets to the plain interval on success."""
+    from attackfl_tpu import cli
+
+    calls = {"n": 0}
+    failures = [ConnectionRefusedError("refused"),
+                http.client.BadStatusLine("''"),
+                ConnectionResetError("reset")]
+
+    def fake_get(url, timeout=5.0):
+        if "last-round" in url:
+            return 200, {"round": 1, "ok": True}
+        n, calls["n"] = calls["n"], calls["n"] + 1
+        if n < len(failures):
+            raise failures[n]
+        return 200, {"status": "ok"}
+
+    sleeps = []
+
+    def fake_sleep(seconds):
+        sleeps.append(seconds)
+        if len(sleeps) >= 6:
+            raise KeyboardInterrupt  # test fuse: stop the poll loop
+
+    monkeypatch.setattr(cli, "_http_get_json", fake_get)
+    monkeypatch.setattr(cli.time, "sleep", fake_sleep)
+    with pytest.raises(KeyboardInterrupt):
+        cli.watch_main(["http://127.0.0.1:9", "--interval", "1"])
+    # three consecutive failures back off 1s, 2s, 4s; the healthy polls
+    # after them sleep the plain interval again (backoff reset)
+    assert sleeps[:5] == [1.0, 2.0, 4.0, 1.0, 1.0]
+    out = capsys.readouterr()
+    assert "retry 3" in out.err
+    assert "round 1" in out.out  # the healthy poll rendered a round line
+
+
+# ---------------------------------------------------------------------------
+# satellite: port 0 -> actual monitor port in the run_header (schema v6)
+# ---------------------------------------------------------------------------
+
+def test_run_header_records_actual_monitor_port(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.config import TelemetryConfig
+    from attackfl_tpu.telemetry.events import validate_event
+    from attackfl_tpu.training.engine import Simulator
+
+    cfg = config_from_dict(job_config(**{"num-round": 1})).replace(
+        log_path=str(tmp_path), checkpoint_dir=str(tmp_path),
+        telemetry=TelemetryConfig(monitor=True, monitor_port=0))
+    sim = Simulator(cfg)
+    try:
+        sim.run(verbose=False, save_checkpoints=False)
+        header = next(json.loads(line)
+                      for line in open(tmp_path / "events.jsonl")
+                      if json.loads(line)["kind"] == "run_header")
+        assert validate_event(header) == []
+        assert header["monitor_port"] == sim.monitor.port > 0
+    finally:
+        sim.close()
+
+
+# ---------------------------------------------------------------------------
+# engine stop hook (the drain seam) across executors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("executor", ["sync", "pipelined", "fused"])
+def test_stop_hook_halts_at_round_boundary(tmp_path, executor):
+    import dataclasses as dc
+
+    from attackfl_tpu.training.engine import Simulator
+
+    tel = dc.replace(Config().telemetry, enabled=False)
+    cfg = config_from_dict(job_config(**{"num-round": 4})).replace(
+        log_path=str(tmp_path), checkpoint_dir=str(tmp_path),
+        telemetry=tel)
+    sim = Simulator(cfg)
+    stop_after = 2
+    if executor == "sync":
+        state, hist = sim.run(verbose=False,
+                              stop=lambda done: done >= stop_after)
+    elif executor == "pipelined":
+        state, hist = sim.run(verbose=False, pipeline=True,
+                              stop=lambda done: done >= stop_after)
+    else:
+        state, hist = sim.run_fast(verbose=False, chunk_size=1,
+                                   stop=lambda done: done >= stop_after)
+    completed = int(state["completed_rounds"])
+    if executor == "pipelined":
+        # depth-1 has one round legitimately in flight when the hook
+        # fires; "finish the in-flight round" means stop_after + 1
+        assert completed in (stop_after, stop_after + 1)
+    else:
+        assert completed == stop_after
+    assert completed < 4  # it DID stop early
+    # the stopped-at state is a valid resume point: finishing from it
+    # matches a straight 4-round run bit-for-bit
+    ref = reference_run(tmp_path, job_config(**{"num-round": 4}))
+    cfg_b = cfg.replace(resume=True)
+    sim_b = Simulator(cfg_b)
+    sim_b.run(verbose=False)
+    assert (tmp_path / "CNNModel.msgpack").read_bytes() == ref
+
+
+# ---------------------------------------------------------------------------
+# schema v6
+# ---------------------------------------------------------------------------
+
+def test_v6_kinds_registered_and_older_schemas_unchanged():
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
+    )
+
+    assert SCHEMA_VERSION == 6
+    assert KINDS_BY_VERSION[6] == frozenset({"job", "service"})
+    assert not ({"job", "service"} & known_kinds(5))
+    assert {"job", "service"} <= known_kinds(6)
+
+
+def test_v6_corpus_validates_and_exercises_new_kinds():
+    from attackfl_tpu.telemetry.events import validate_event
+
+    path = REPO / "tests" / "data" / "events.v6.jsonl"
+    events = [json.loads(line) for line in path.open()]
+    assert all(validate_event(e) == [] for e in events)
+    kinds = {e["kind"] for e in events}
+    assert {"job", "service"} <= kinds
+    actions = {e["action"] for e in events if e["kind"] == "job"}
+    assert {"submitted", "started", "completed", "requeued",
+            "rejected"} <= actions
+    assert {e["action"] for e in events if e["kind"] == "service"} \
+        >= {"started", "replayed", "draining", "drained"}
+    faults = {e["fault"] for e in events if e["kind"] == "fault"}
+    assert {"worker_death", "queue_torn", "submit_flood"} <= faults
+
+
+def test_monitor_port_header_field_type_checked():
+    from attackfl_tpu.telemetry.events import validate_event
+
+    good = {"schema": 6, "kind": "run_header", "ts": 1.0, "run_id": "r",
+            "backend": "cpu", "num_devices": 1, "mode": "fedavg",
+            "model": "CNNModel", "data_name": "ICU", "monitor_port": 8780}
+    assert validate_event(good) == []
+    bad = dict(good, monitor_port="8780")
+    assert any("monitor_port" in problem for problem in validate_event(bad))
+    del good["monitor_port"]  # absent stays valid (v5-shaped header)
+    assert validate_event(good) == []
