@@ -66,6 +66,11 @@ FAULTS_FILES = (
 # itself (NO allowlisted functions by design; every sync a worker needs
 # already lives behind the engine's audited resolve points)
 SERVICE_DIR = REPO / "attackfl_tpu" / "service"
+# the scenario matrix (ISSUE 9): grid logic + the batched round-body
+# builders are traced-only (NO allowlist by design — the sweep's single
+# audited materialization lives in training/matrix_exec.py, which the
+# TRAINING glob already covers with its own allowlist entries below)
+MATRIX_DIR = REPO / "attackfl_tpu" / "matrix"
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -111,6 +116,18 @@ ALLOWED_FUNCTIONS: dict[str, set[str]] = {
     "numerics.py": {
         "NumericsDrainer.drain",
     },
+    #   - matrix_exec.py MatrixRun._resolve_chunk: the sweep's ONE
+    #     device->host materialization — a single batched copy of each
+    #     chunk's metrics covering every cell x round in the dispatch
+    #     (per-cell numerics rows ride it); also the async-dispatch
+    #     block, run_fast-style
+    #   - MatrixRun._min_completed: the sweep's progress gate — a few
+    #     int32 scalars per chunk (the analog of run_fast's
+    #     completed_rounds read)
+    "matrix_exec.py": {
+        "MatrixRun._resolve_chunk",
+        "MatrixRun._min_completed",
+    },
 }
 
 # basename -> live module the allowlist entries must resolve against.
@@ -121,6 +138,7 @@ ALLOWLIST_MODULES: dict[str, str] = {
     "engine.py": "attackfl_tpu.training.engine",
     "round.py": "attackfl_tpu.training.round",
     "numerics.py": "attackfl_tpu.telemetry.numerics",
+    "matrix_exec.py": "attackfl_tpu.training.matrix_exec",
 }
 
 HOST_SYNC_HINT = (
@@ -233,7 +251,8 @@ def resolve_host_sync_allowlist() -> list[Finding]:
 
 def host_sync_files() -> list[Path]:
     return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
-            + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py")))
+            + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py"))
+            + sorted(MATRIX_DIR.glob("*.py")))
 
 
 @register(

@@ -36,7 +36,8 @@ def build_report(skip_programs: bool = False, retrace: bool = False,
     if not skip_programs:
         from attackfl_tpu.analysis import program_audit
 
-        reports = program_audit.audit_default_programs()
+        reports = (program_audit.audit_default_programs()
+                   + program_audit.audit_matrix_program())
         programs = [r.to_dict() for r in reports]
         findings.extend(program_audit.reports_to_findings(reports))
         budget = program_audit.transfer_budget()

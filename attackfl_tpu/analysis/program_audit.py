@@ -243,6 +243,34 @@ def audit_default_programs(modes: tuple[str, ...] = ("fedavg",)
     return reports
 
 
+def audit_matrix_program() -> list[ProgramReport]:
+    """Audit the scenario-matrix engine's batched grid program (ISSUE 9)
+    on a representative small grid: the vmapped/switched/mapped sweep
+    body must satisfy the same invariants as the single-run executors —
+    zero callback/transfer primitives, donation aliasing as declared,
+    no wide dtypes."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.matrix.grid import grid_from_dict
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    cfg = audit_config(prng_impl="threefry2x32")
+    # one attack keeps the audit's trace/lower cost bounded (tier-1 runs
+    # this via scripts/audit.sh); the slow acceptance test audits the
+    # full 5-attack grid program
+    grid = grid_from_dict({
+        "attacks": ["LIE"], "attack-clients": 1, "attack-round": 2,
+        "defenses": ["fedavg", "krum", "FLTrust"], "seeds": [1],
+        "rounds": 2,
+    })
+    runner = MatrixRun(cfg, grid)
+    try:
+        return [audit_program(p["name"], p["executor"], p["raw"],
+                              p["jit"], p["args"], p["donate"])
+                for p in runner.audit_programs()]
+    finally:
+        runner.close()
+
+
 def reports_to_findings(reports: list[ProgramReport]) -> list[Finding]:
     """Program-level problems as findings (rule ``program-audit``; the
     'file' is the program name — there is no single source line)."""

@@ -449,6 +449,16 @@ def job_main(argv=None) -> int:
     return _job_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def matrix_main(argv=None) -> int:
+    """``attackfl-tpu matrix``: the scenario-matrix engine (ISSUE 9) —
+    ``run`` executes a full (attack × defense × seed) grid as ONE
+    compiled device program (host-side defenses fall back per-cell),
+    ``status`` renders the sweep's per-cell ledger records."""
+    from attackfl_tpu.matrix.cli import main as _matrix_main
+
+    return _matrix_main(list(sys.argv[1:] if argv is None else argv))
+
+
 def ledger_main(argv=None) -> int:
     """``attackfl-tpu ledger``: the persistent cross-run store —
     ``list``/``show`` query it, ``compare`` diffs two runs (or a run
@@ -468,6 +478,7 @@ _SUBCOMMANDS = {
     "watch": watch_main,
     "audit": audit_main,
     "ledger": ledger_main,
+    "matrix": matrix_main,
     "serve": serve_main,
     "job": job_main,
 }
@@ -487,6 +498,9 @@ commands:
   ledger   persistent cross-run store: list/show records, compare two runs
            (perf + numerics + forensics columns), regress = CI gate with
            noise-aware thresholds, import = backfill BENCH_*.json
+  matrix   scenario-matrix engine: run a full (attack x defense x seed)
+           grid as ONE compiled program (per-cell ledger records share a
+           sweep_id); status renders the grid's completion table
   serve    resilient run service: durable job queue + supervised workers +
            admission control + HTTP control plane; SIGTERM drains, kill -9
            is recovered by queue replay + checkpoint resume

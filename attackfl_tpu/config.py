@@ -240,6 +240,12 @@ class AttackSpec:
     def __post_init__(self):
         if self.mode not in ATTACK_MODES:
             raise ValueError(f"Unknown attack mode {self.mode!r}; choose from {ATTACK_MODES}")
+        # normalize args to floats HERE so every producer (YAML, CLI,
+        # matrix grids) yields identical specs — and identical config
+        # fingerprints — for e.g. `args: [50, 1]` vs `args: [50.0, 1.0]`
+        object.__setattr__(
+            self, "args", tuple(float(x) for x in self.args))
+        object.__setattr__(self, "client_ids", tuple(self.client_ids))
 
 
 @dataclass(frozen=True)
@@ -324,6 +330,13 @@ class Config:
     num_data_range: tuple[int, int] = (12000, 15000)
     genuine_rate: float = 0.5
     random_seed: int = 1
+    # Dataset seed, when it must be decoupled from the simulation seed
+    # (ISSUE 9): the scenario matrix sweeps `random_seed` as its per-cell
+    # axis while every cell shares ONE synthetic dataset — cell configs
+    # pin `data_seed` to the sweep's base seed so a standalone replay of
+    # a cell sees the same data the sweep did.  None (the default) keeps
+    # the historical coupling: the dataset is seeded by `random_seed`.
+    data_seed: int | None = None
     hyper_detection: HyperDetectionConfig = field(default_factory=HyperDetectionConfig)
     # Hypernetwork class for mode 'hyper': the generic spec-derived
     # "HyperNetwork" (reference server.py:800) or the CNNModel-specialized
@@ -630,6 +643,8 @@ def config_from_dict(raw: dict) -> Config:
         num_data_range=(int(ndr[0]), int(ndr[1])),
         genuine_rate=float(_get(server, "genuine-rate", defaults.genuine_rate)),
         random_seed=int(_get(server, "random-seed", defaults.random_seed) or 0),
+        data_seed=(int(_get(server, "data-seed", 0))
+                   if _get(server, "data-seed", None) is not None else None),
         hyper_detection=HyperDetectionConfig(
             enable=bool(_get(hd, "enable", False)),
             cosine_search=int(_get(hd, "cosine-search", 10)),

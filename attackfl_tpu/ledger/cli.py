@@ -50,7 +50,10 @@ def format_list(entries: list[dict[str, Any]]) -> str:
              f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"]
     for entry in entries:
         workload = "-"
-        if entry.get("model") or entry.get("mode"):
+        if entry.get("cell"):
+            # a matrix cell record: the cell key IS the workload identity
+            workload = str(entry["cell"])
+        elif entry.get("model") or entry.get("mode"):
             workload = (f"{entry.get('model') or '?'}/"
                         f"{entry.get('mode') or '?'}"
                         f" c{entry.get('total_clients') or '?'}")

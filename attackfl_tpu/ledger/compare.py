@@ -169,14 +169,22 @@ def rolling_baseline(records: list[dict[str, Any]],
                      candidate: dict[str, Any],
                      window: int = 5) -> dict[str, Any] | None:
     """Synthetic baseline record: the median over the last ``window``
-    records sharing the candidate's fingerprint + executor (the candidate
-    itself excluded — by record_id when it has one, by identity
-    otherwise).  None when no peer exists."""
+    records sharing the candidate's fingerprint + executor + matrix cell
+    (the candidate itself excluded — by record_id when it has one, by
+    identity otherwise).  None when no peer exists.
+
+    The ``cell`` key (ISSUE 9): per-cell matrix records can share a
+    config fingerprint (the sweep's base config collapses in edge cases
+    — e.g. records imported without full configs), so baseline peers
+    must ALSO agree on the (attack × defense × seed) cell identity.
+    Non-matrix records carry no ``cell`` and match each other as before
+    (None == None)."""
     fingerprint = candidate.get("fingerprint")
     peers = [r for r in records
              if r is not candidate
              and r.get("fingerprint") == fingerprint
              and r.get("executor") == candidate.get("executor")
+             and r.get("cell") == candidate.get("cell")
              and (candidate.get("record_id") is None
                   or r.get("record_id") != candidate.get("record_id"))]
     if not peers or not fingerprint:
@@ -200,6 +208,7 @@ def rolling_baseline(records: list[dict[str, Any]],
         "source": "baseline",
         "fingerprint": fingerprint,
         "executor": candidate.get("executor"),
+        "cell": candidate.get("cell"),
         "baseline_of": [r.get("record_id") for r in peers],
     }
     for key, _ in PERF_COLUMNS:

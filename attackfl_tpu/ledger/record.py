@@ -403,6 +403,21 @@ def records_from_bench(parsed: dict[str, Any]) -> list[dict[str, Any]]:
                 if "overhead_pct" in detail:
                     record["overhead_pct"] = detail["overhead_pct"]
                 records.append(record)
+    elif metric.startswith("fl_matrix_vs_serial"):
+        # matrix-compare (ISSUE 9): one record per sweep variant so the
+        # serial and batched trajectories each get their own baseline
+        for variant, executor in (("serial", "fused"),
+                                  ("batched", "matrix")):
+            block = detail.get(variant)
+            if isinstance(block, dict):
+                record = rate_record(variant, executor, block)
+                record["wall_seconds"] = block.get("warm_wall_s")
+                record["cold_wall_s"] = block.get("cold_wall_s")
+                for key in ("speedup_cold", "speedup_warm",
+                            "compile_once_saving_s"):
+                    if key in detail:
+                        record[key] = detail[key]
+                records.append(record)
     elif metric.startswith("fl_compile_cache"):
         for variant in ("first_run", "warm_cache"):
             block = detail.get(variant)

@@ -46,9 +46,11 @@ LOCK_NAME = "ledger.lock"
 INDEX_VERSION = 1
 
 # The per-record summary the index carries (and `ledger list` renders).
+# `sweep_id`/`cell` (ISSUE 9) are None on non-matrix records — the index
+# self-heals from the JSONL, so pre-v9 indexes simply rebuild with them.
 INDEX_FIELDS = ("record_id", "ts", "run_id", "fingerprint", "executor",
                 "source", "mode", "model", "total_clients", "rounds",
-                "ok_rounds", "rounds_per_sec_steady")
+                "ok_rounds", "rounds_per_sec_steady", "sweep_id", "cell")
 
 
 def resolve_ledger_dir(explicit: str | None = None,

@@ -1,0 +1,32 @@
+"""Scenario matrix engine (ISSUE 9): one compiled program per sweep.
+
+The paper's result table is a cross product — attacks × defenses × seeds
+— and running it as 45×k serial processes pays 45×k compiles plus 45×k
+rounds of dispatch/ledger/telemetry plumbing.  This package compiles the
+whole grid ONCE and runs it as one device program:
+
+* :mod:`attackfl_tpu.matrix.grid` — the grid spec (attack specs, defense
+  modes, seeds), cell expansion, and per-cell standalone configs (the
+  parity contract: every cell's final params are bit-identical to a
+  standalone ``attackfl-tpu run`` of its cell config);
+* :mod:`attackfl_tpu.matrix.program` — the traced-only batched round
+  body: per attack, vmap over the (defense × seed) cell axis with a
+  ``lax.switch`` defense dispatch for the vmap-bit-stable defenses, and
+  ``lax.map`` (sequential, unbatched per cell — bit-identical by
+  construction) for FLTrust, whose in-aggregate root training XLA lowers
+  differently when batched;
+* :mod:`attackfl_tpu.matrix.records` — per-cell ledger records sharing a
+  ``sweep_id`` (k×45 records from one submit);
+* :mod:`attackfl_tpu.matrix.cli` — ``attackfl-tpu matrix run|status``.
+
+The executor itself lives in :mod:`attackfl_tpu.training.matrix_exec`
+(``MatrixRun``) because the host-side chunk resolution is an audited
+sync point under the host-sync lint, exactly like the engine's existing
+executors; everything in THIS package is traced-only / sync-free (linted
+with NO allowlist).
+"""
+
+from attackfl_tpu.matrix.grid import (  # noqa: F401
+    BATCHED_DEFENSES, HOST_DEFENSES, MAPPED_DEFENSES, Cell, GridSpec,
+    cell_config, expand_cells, grid_from_dict,
+)

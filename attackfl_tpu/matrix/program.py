@@ -1,0 +1,152 @@
+"""The batched matrix round body — traced-only, sync-free.
+
+One sweep round is ONE device program assembled from static compile
+groups (attack-major, matching :func:`attackfl_tpu.matrix.grid.
+expand_cells`):
+
+* per attack mode, ONE ``round_step`` is built (the attack geometry is
+  static program structure) and its cells vmap over the (defense × seed)
+  axis — the per-cell defense is a ``lax.switch`` over the grid's
+  shape-compatible aggregate branches, driven by a per-cell index array;
+* FLTrust cells ride ``lax.map`` over the same body (sequential slices,
+  unbatched — the bit-identity rationale lives in
+  :mod:`attackfl_tpu.matrix.grid`).
+
+The cell body mirrors the engine's fused scan body
+(``Simulator._build_fused_body``, plain branch) operation for
+operation — same rng split pattern, same validation cadence gate, same
+accept-select, same train-failed metric masking — because the parity
+contract (cell == standalone run, bit-for-bit) is only as strong as
+that mirror.  ``tests/test_matrix.py`` enforces it against both the
+sync and fused standalone executors.
+
+Everything here is traced: the host-sync lint runs over this package
+with NO allowlist.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def build_cell_body(
+    round_step: Callable,
+    branches: list[Callable],
+    num_clients: int,
+    eval_fn: Callable | None,
+    val_every: int,
+    numerics_step: Callable | None,
+) -> Callable:
+    """One cell's round as a pure function of (cell_state, defense_idx).
+
+    ``branches`` are uniform-signature aggregates
+    ``(global_params, stacked, sizes, weights_mask, rng) -> new_global``;
+    a single-branch list skips the switch entirely (the mapped/FLTrust
+    group, where the defense is static).  The body is the engine's fused
+    plain-mode body with the aggregate dispatch swapped for the switch.
+    """
+    wmask = jnp.ones((num_clients,), jnp.float32)
+    val_every = max(int(val_every), 1)
+
+    def gated_eval(b, make_ev):
+        # validation cadence on the broadcast clock — the same gate the
+        # fused body applies, so skipped rounds pay no eval FLOPs and
+        # report NaN metrics
+        if val_every == 1:
+            return make_ev(None)
+        struct = jax.eval_shape(make_ev, None)
+
+        def skip(_):
+            return {
+                k: (jnp.ones(s.shape, s.dtype) if k == "ok"
+                    else jnp.full(s.shape, jnp.nan, s.dtype))
+                for k, s in struct.items()
+            }
+
+        return jax.lax.cond(b % val_every == 0, make_ev, skip, None)
+
+    def accept(flag, new, old):
+        return jax.tree.map(lambda n, o: jnp.where(flag, n, o), new, old)
+
+    def body(state, defense_idx):
+        rng, k_round, k_agg = jax.random.split(state["rng"], 3)
+        b = state["broadcasts"] + 1
+        stacked, sizes, new_gen, train_ok, loss = round_step(
+            state["global_params"], state["prev_genuine"],
+            state["have_genuine"], k_round, b,
+        )
+        round_mask = wmask * (sizes > 0)
+        if len(branches) == 1:
+            new_global = branches[0](
+                state["global_params"], stacked, sizes, round_mask, k_agg)
+        else:
+            new_global = jax.lax.switch(
+                defense_idx, branches,
+                state["global_params"], stacked, sizes, round_mask, k_agg)
+        ok = train_ok & jnp.any(round_mask > 0)
+        metrics = {"train_loss": loss}
+        if eval_fn is not None:
+            ev = gated_eval(b, lambda _: eval_fn(params=new_global))
+            ok = ok & ev.pop("ok")
+            # train-failed rounds mask their val metrics to NaN (history
+            # parity with the per-round path, same as the fused body)
+            metrics.update(
+                {k: jnp.where(train_ok, v, jnp.nan) for k, v in ev.items()})
+        new_state = {
+            "global_params": accept(ok, new_global, state["global_params"]),
+            # round_step selects the leak pool internally (ok-gated)
+            "prev_genuine": new_gen,
+            "have_genuine": state["have_genuine"] | train_ok,
+            "rng": rng,
+            "completed_rounds": state["completed_rounds"]
+            + ok.astype(jnp.int32),
+            "broadcasts": b,
+        }
+        if numerics_step is not None:
+            new_state["numerics"], metrics["numerics_row"] = numerics_step(
+                state["numerics"], state["global_params"],
+                new_state["global_params"], stacked, sizes, loss, ok, b)
+        metrics["ok"] = ok
+        return new_state, metrics
+
+    return body
+
+
+def build_matrix_body(groups: dict[str, dict[str, Any]]) -> Callable:
+    """The whole grid's round as one traced function over the grouped
+    state pytree.
+
+    ``groups`` maps a stable group name (``"<attack>:batched"`` /
+    ``"<attack>:mapped"``) to ``{"body": cell_body, "kind":
+    "batched"|"mapped", "defense_idx": jnp.ndarray | None}``.  Batched
+    groups vmap the body over their stacked cell axis (defense_idx is
+    the per-cell switch driver); mapped groups ``lax.map`` it (their
+    body closed over a single static branch — defense_idx unused).
+
+    The returned callable has the scan-body shape
+    ``(state, _) -> (state, metrics)`` so the executor can wrap it in
+    ``lax.scan`` for chunked dispatch exactly like the fused executor.
+    """
+    # static iteration order: group name — deterministic program
+    # structure across processes (a set here would be a retrace hazard)
+    names = sorted(groups)
+
+    def matrix_body(state, _):
+        new_state: dict[str, Any] = {}
+        metrics: dict[str, Any] = {}
+        for name in names:
+            group = groups[name]
+            body = group["body"]
+            if group["kind"] == "batched":
+                didx = group["defense_idx"]
+                new_state[name], metrics[name] = jax.vmap(body)(
+                    state[name], didx)
+            else:
+                new_state[name], metrics[name] = jax.lax.map(
+                    lambda s, b=body: b(s, jnp.asarray(0)), state[name])
+        return new_state, metrics
+
+    return matrix_body

@@ -1,0 +1,120 @@
+"""Per-cell ledger records: one sweep submit -> k×45 records.
+
+Distills the executor's per-cell round histories (already host Python —
+the chunk resolution materialized them) into one ledger record per cell,
+all sharing a ``sweep_id``.  Jax-free and sync-free by construction:
+this is pure dict-shaping over values the executor hands in.
+
+Cell records join the cross-run ledger on TWO keys:
+
+* ``fingerprint`` — the fingerprint of the cell's STANDALONE config
+  (:func:`attackfl_tpu.matrix.grid.cell_config`), so a matrix cell and
+  its standalone parity twin share a baseline pool (their params are
+  bit-identical by contract, like sync/pipelined runs today);
+* ``cell`` — the flat cell key.  The rolling-baseline selector
+  (:func:`attackfl_tpu.ledger.compare.rolling_baseline`) matches peers
+  on it, so two cells that happen to share a config fingerprint can
+  never cross-contaminate each other's baselines (the ISSUE 9
+  satellite).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from attackfl_tpu.ledger.record import LEDGER_SCHEMA_VERSION
+from attackfl_tpu.matrix.grid import Cell, cell_config
+from attackfl_tpu.utils.fingerprint import config_fingerprint
+
+# final-quality keys lifted from a cell's last ok round, when present
+_QUALITY_KEYS = ("roc_auc", "accuracy", "nll", "train_loss")
+
+
+def _final_quality(history: list[dict[str, Any]]) -> dict[str, float]:
+    final: dict[str, float] = {}
+    for entry in history:
+        for key in _QUALITY_KEYS:
+            value = entry.get(key)
+            if (isinstance(value, (int, float))
+                    and not isinstance(value, bool) and value == value):
+                final[key] = round(value, 6)
+    return final
+
+
+def cell_record(
+    *,
+    sweep_id: str,
+    cell: Cell,
+    base_cfg,
+    rounds: int,
+    history: list[dict[str, Any]],
+    run_id: str | None,
+    ts: float | None,
+    wall_s: float,
+    n_cells: int,
+    executor: str = "matrix",
+    resumed: bool = False,
+    provenance: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """One cell's ledger record (``ledger_schema`` 1, ``source``
+    "matrix").  ``wall_s`` is the SWEEP wall clock: cells share every
+    dispatch, so the honest per-cell attribution is the amortized share
+    — recorded as such, never dressed up as a standalone measurement."""
+    cfg = cell_config(base_cfg, cell, rounds=rounds)
+    ok_rounds = sum(1 for h in history if h.get("ok"))
+    amortized = wall_s / max(n_cells, 1)
+    record: dict[str, Any] = {
+        "ledger_schema": LEDGER_SCHEMA_VERSION,
+        "ts": ts,
+        "source": "matrix",
+        "run_id": run_id,
+        "executor": executor,
+        "resumed": resumed,
+        "fingerprint": config_fingerprint(cfg),
+        "sweep_id": sweep_id,
+        "cell": cell.key,
+        "cell_detail": cell.describe(),
+        "mode": cell.defense,
+        "model": base_cfg.model,
+        "data_name": base_cfg.data_name,
+        "total_clients": base_cfg.total_clients,
+        "rounds": len(history),
+        "ok_rounds": ok_rounds,
+        "wall_seconds": round(wall_s, 6),
+        "rounds_per_sec_steady": (
+            round(len(history) / wall_s, 6) if wall_s > 0 else None),
+        "time_attribution": {
+            "wall_s": round(wall_s, 6),
+            "amortized_cell_wall_s": round(amortized, 6),
+        },
+        "counts": {
+            "rounds_failed": len(history) - ok_rounds,
+        },
+        "final": _final_quality(history),
+    }
+    record.update(provenance or {})
+    return record
+
+
+def sweep_records(
+    *,
+    sweep_id: str,
+    cells: list[Cell],
+    histories: dict[str, list[dict[str, Any]]],
+    base_cfg,
+    rounds: int,
+    run_id: str | None,
+    ts: float | None,
+    wall_s: float,
+    resumed: bool = False,
+    provenance: dict[str, Any] | None = None,
+) -> list[dict[str, Any]]:
+    """Records for every cell that has a history, in grid order."""
+    return [
+        cell_record(
+            sweep_id=sweep_id, cell=cell, base_cfg=base_cfg, rounds=rounds,
+            history=histories.get(cell.key) or [], run_id=run_id, ts=ts,
+            wall_s=wall_s, n_cells=len(cells), resumed=resumed,
+            provenance=provenance)
+        for cell in cells if cell.key in histories
+    ]

@@ -7,7 +7,11 @@ run` into `a system that serves`.
   (atomic temp+fsync+rename spool with sealed-entry torn detection);
 * :mod:`attackfl_tpu.service.worker` — one supervised worker per
   running job: isolated telemetry/checkpoint directory, shared ledger
-  record, restart-with-backoff on crashes, graceful-drain stop hook;
+  record, restart-with-backoff on crashes, graceful-drain stop hook.
+  A job spec with ``type: "matrix"`` (ISSUE 9) runs the scenario-matrix
+  executor instead: ONE sealed queue entry expands to one compiled
+  (attack × defense × seed) sweep plus a full grid of per-cell ledger
+  records in the shared service ledger;
 * :mod:`attackfl_tpu.service.daemon` — the :class:`RunService` itself:
   admission control, queue replay + resume after kill -9, SIGTERM
   drain, and the HTTP control plane (submit/status/cancel beside the

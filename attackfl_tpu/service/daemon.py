@@ -224,6 +224,14 @@ class RunService:
         explicit).  Draining services refuse new work the same way."""
         if self._draining.is_set():
             raise QueueFullError("service is draining; resubmit after restart")
+        if spec.get("type") == "matrix":
+            # a matrix job (ISSUE 9): ONE sealed queue entry expands to
+            # one compiled sweep program + a grid of ledger records —
+            # validate the grid NOW so a malformed sweep is a 400 at
+            # submit, not a worker crash-loop later
+            from attackfl_tpu.matrix.grid import grid_from_dict
+
+            grid_from_dict(dict(spec.get("grid") or {}))
         if not spec.get("config"):
             spec = dict(spec, config=self.base_config)
         return self.queue.submit(spec)

@@ -163,6 +163,8 @@ class JobWorker(threading.Thread):
             self.job.spec, self.job_dir, self.ledger_dir, resume=resume,
             run_monitor=self.run_monitor,
             compile_cache_dir=self.compile_cache_dir)
+        if self.job.spec.get("type") == "matrix":
+            return self._execute_matrix(cfg, resume)
         num_rounds = self.job.spec.get("num_rounds") or cfg.num_round
         sim = Simulator(cfg)
         self.sim = sim
@@ -184,6 +186,40 @@ class JobWorker(threading.Thread):
             "target": int(num_rounds),
             "ok_rounds": sum(1 for h in history if h.get("ok")),
             "interrupted": completed < int(num_rounds),
+        }
+
+    def _execute_matrix(self, cfg, resume: bool) -> dict[str, Any]:
+        """A ``matrix`` job (ISSUE 9): ONE sealed queue entry expands to
+        one compiled sweep program plus a full grid of per-cell ledger
+        records in the SHARED service ledger.  The sweep's chunk
+        boundary is the drain/cancel seam (the stop hook), and restarts
+        resume from the sweep checkpoint byte-identically — the same
+        supervision contract plain run jobs get."""
+        from attackfl_tpu.matrix.grid import grid_from_dict
+        from attackfl_tpu.training.matrix_exec import MatrixRun
+
+        grid = grid_from_dict(dict(self.job.spec.get("grid") or {}))
+        if cfg.prng_impl != "threefry2x32":
+            cfg = cfg.replace(prng_impl="threefry2x32")
+        cfg = cfg.replace(resume=resume or cfg.resume)
+        runner = MatrixRun(cfg, grid,
+                           sweep_id=self.job.spec.get("sweep_id")
+                           or self.job.job_id)
+        try:
+            self.queue.mark(self.job.job_id, "running",
+                            sweep_id=runner.sweep_id)
+            _, histories = runner.run(stop=self._stop_hook, verbose=False)
+        finally:
+            runner.close()
+        # the runner knows whether a stop hook cut it short — histories
+        # alone can't tell (a resumed sweep's cells re-run zero rounds)
+        interrupted = runner.interrupted
+        return {
+            "completed": 0 if interrupted else grid.n_cells,
+            "target": grid.n_cells,
+            "ok_rounds": sum(1 for h in histories.values()
+                             for e in h if e.get("ok")),
+            "interrupted": interrupted,
         }
 
     def run(self) -> None:  # thread body

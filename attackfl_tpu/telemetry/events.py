@@ -72,6 +72,19 @@ Schema v6 (ISSUE 8) extends v5 — every v1-v5 file still validates:
   run directory can find its health endpoint.  Type-checked when
   present; v1-v5 headers carry none of it.
 
+Schema v7 (ISSUE 9) extends v6 — every v1-v6 file still validates:
+
+* ``matrix`` — one scenario-sweep lifecycle transition (``sweep_id`` +
+  ``action`` = started/chunk/fallback/cell_done/cell_aborted/resumed/
+  interrupted/completed) from the matrix executor
+  (:mod:`attackfl_tpu.training.matrix_exec`): the whole
+  (attack × defense × seed) grid is one run record, so per-round events
+  are rolled up per chunk instead of exploding k×45-fold;
+* ``run_header`` MAY carry ``sweep_id`` and ``cell`` — a matrix sweep
+  stamps its own header with the sweep id, and each fallback cell's
+  child run carries both, so cell artifacts join their sweep.
+  Type-checked when present; v1-v6 headers carry none of them.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -88,7 +101,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -138,6 +151,12 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # the service daemon's own lifecycle: started/replayed/draining/
     # drained/stopped, with crash-recovery replay evidence riding along
     "service": {"action": str},
+    # --- schema v7 kind (ISSUE 9) ---
+    # scenario-matrix sweep lifecycle: one record per transition
+    # (started/chunk/fallback/cell_done/cell_aborted/resumed/
+    # interrupted/completed) — the whole (attack x defense x seed) grid
+    # is one run record
+    "matrix": {"sweep_id": str, "action": str},
 }
 
 # --- schema v3: optional numerics payload on `metric` events ---
@@ -146,12 +165,14 @@ _OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
     "round": int, "broadcast": int, "numerics": dict, "hist": list,
 }
 
-# --- schema v5/v6: optional provenance fields on `run_header` events ---
+# --- schema v5/v6/v7: optional provenance fields on `run_header` events
 # (type-checked when present; v1-v4 headers carry none of these;
-# monitor_port — the ACTUAL bound port under `monitor-port: 0` — is v6)
+# monitor_port — the ACTUAL bound port under `monitor-port: 0` — is v6;
+# sweep_id/cell — matrix-sweep membership — are v7)
 _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     "git_rev": str, "jaxlib_version": str, "platform": str,
     "monitor_port": int,
+    "sweep_id": str, "cell": str,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -169,6 +190,7 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     4: frozenset({"fault", "degrade", "resume"}),
     5: frozenset({"ledger"}),  # + optional run_header provenance fields
     6: frozenset({"job", "service"}),  # + optional run_header monitor_port
+    7: frozenset({"matrix"}),  # + optional run_header sweep_id/cell
 }
 
 

@@ -108,11 +108,16 @@ class Simulator:
             enable_compile_cache(self._compile_cache_dir)
         self._cache_stats_start = compile_cache_stats()
 
+        # data_seed decouples the dataset from the simulation seed (ISSUE
+        # 9): matrix cell configs sweep random_seed while sharing the
+        # sweep's one dataset
+        data_seed = (cfg.data_seed if cfg.data_seed is not None
+                     else cfg.random_seed)
         train_np = train_data if train_data is not None else get_dataset(
-            cfg.data_name, "train", cfg.train_size, cfg.random_seed
+            cfg.data_name, "train", cfg.train_size, data_seed
         )
         test_np = test_data if test_data is not None else get_dataset(
-            cfg.data_name, "test", cfg.test_size, cfg.random_seed
+            cfg.data_name, "test", cfg.test_size, data_seed
         )
         self.train_data = {k: jnp.asarray(v) for k, v in train_np.items()}
         self.test_np = test_np
@@ -194,6 +199,11 @@ class Simulator:
         else:
             self.telemetry = Telemetry.from_config(cfg)
         self._header_emitted = False
+        # extra run_header fields a wrapping executor wants recorded —
+        # the scenario matrix (ISSUE 9) stamps its fallback cells' runs
+        # with the sweep's `sweep_id` + the cell key (schema v7 optional
+        # run_header fields), so cell artifacts join their sweep
+        self.header_extra: dict[str, Any] = {}
         # in-graph numerics (ISSUE 4): decided before the round programs
         # are jitted because it changes their donation policy (below)
         self._numerics_on = bool(self.telemetry.enabled
@@ -797,6 +807,8 @@ class Simulator:
             **({"monitor_port": int(self.monitor.port)}
                if self.monitor is not None and self.monitor.port is not None
                else {}),
+            # schema v7: sweep_id/cell when this run is a matrix cell
+            **self.header_extra,
         )
         if self._resume_info is not None:
             # exactly-once round accounting: the resumed run declares the

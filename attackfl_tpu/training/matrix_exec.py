@@ -1,0 +1,715 @@
+"""MatrixRun: the scenario-matrix executor (ISSUE 9 tentpole).
+
+Runs a full (attack × defense × seed) grid as ONE compiled device
+program per chunk: the device cells (vmap-stable defenses + FLTrust —
+see :mod:`attackfl_tpu.matrix.grid` for the classification) share one
+jitted ``lax.scan`` over the batched matrix body, while host-side
+defenses (gmm / fltracer) and the structure-incompatible hyper mode
+fall back to per-cell child Simulators — gmm/fltracer per-cell
+SYNCHRONOUS with a warning (exactly like the pipelined executor's
+fallback today), hyper per-cell on its own compiled fused program.
+
+Executor contract, mirrored from ``run_fast``:
+
+* **bit-identity** — every cell's final params equal a standalone
+  ``Simulator.run`` / ``run_fast`` of its
+  :func:`~attackfl_tpu.matrix.grid.cell_config`, byte for byte
+  (tests/test_matrix.py).  A cell that reaches its round target is
+  FROZEN in-program (``jnp.where`` select over the whole cell state) so
+  straggler cells retrying failed rounds never advance finished ones.
+* **crash safety** — the batched grid state is checkpointed per chunk
+  through the same :class:`~attackfl_tpu.utils.checkpoint.
+  CheckpointManager` the engine uses (round-stamped entries, manifest,
+  torn-entry fallback); fallback cells checkpoint through their own
+  child Simulators.  ``resume=True`` restores the newest valid entry
+  and re-runs fallback cells with ``resume`` (completed cells reload
+  their final state and run zero rounds), so a killed sweep resumes
+  byte-identical.  Restored sweeps keep state donation OFF (the jax
+  0.4.37 latch, same as the engine).
+* **observability** — schema-v7 ``matrix`` events (started / chunk /
+  fallback / cell_done / cell_aborted / resumed / interrupted /
+  completed), per-cell numerics rows riding the chunk's existing
+  materialization (zero new syncs), and k×45 per-cell ledger records
+  sharing a ``sweep_id`` (:mod:`attackfl_tpu.matrix.records`).
+* **quarantine, not collapse** — a cell that exceeds the per-cell retry
+  budget (a NaN-poisoned trajectory that can never recover — the
+  standalone run would ABORT there) is quarantined: it stops counting
+  toward sweep progress and its abort is recorded, while the other
+  cells' science completes.
+
+Host-sync policy: the ONLY device->host materialization is
+``MatrixRun._resolve_chunk`` (allowlisted, like ``Simulator.run_fast``);
+everything under :mod:`attackfl_tpu.matrix` stays traced-only with NO
+allowlist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from attackfl_tpu.config import Config
+from attackfl_tpu.data.synthetic import get_dataset
+from attackfl_tpu.eval.validation import Validation
+from attackfl_tpu.matrix.grid import (
+    Cell, GridSpec, cell_config, defense_group, expand_cells,
+)
+from attackfl_tpu.matrix.program import build_cell_body, build_matrix_body
+from attackfl_tpu.matrix.records import sweep_records
+from attackfl_tpu.ops import metrics as num_metrics
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.registry import get_model
+from attackfl_tpu.telemetry import Telemetry, print_with_color
+from attackfl_tpu.telemetry.numerics import NumericsDrainer
+from attackfl_tpu.training.round import (
+    build_attack_groups, build_cohort_masks, build_defense_branches,
+    build_round_step,
+)
+from attackfl_tpu.utils import checkpoint as ckpt
+from attackfl_tpu.utils.fingerprint import config_fingerprint
+
+MAX_CELL_RETRIES = 20  # per-cell consecutive-failure abort, like run_fast
+
+MATRIX_STATE_FILE = "matrix.msgpack"
+
+
+class _CellTelemetry:
+    """Per-cell facade over the sweep telemetry: every emitted event is
+    stamped with the cell key (the numerics drainers emit through this,
+    so their ``metric`` events are per-cell attributable)."""
+
+    def __init__(self, telemetry, cell_key: str):
+        self._tel = telemetry
+        self.counters = telemetry.counters
+        self.events = self
+        self._cell = cell_key
+
+    def emit(self, kind: str, **fields: Any):
+        return self._tel.events.emit(kind, cell=self._cell, **fields)
+
+
+class MatrixRun:
+    """One sweep: a base workload Config + a GridSpec."""
+
+    def __init__(self, cfg: Config, grid: GridSpec,
+                 sweep_id: str | None = None,
+                 telemetry: Telemetry | None = None):
+        grid.validate_base(cfg)
+        self.cfg = cfg
+        self.grid = grid
+        self.sweep_id = sweep_id or uuid.uuid4().hex[:12]
+        self.cells = expand_cells(grid)
+        self.device_cells = [c for c in self.cells
+                             if c.group in ("batched", "mapped")]
+        self.fallback_cells = [c for c in self.cells
+                               if c.group in ("host", "special")]
+        self.telemetry = (telemetry if telemetry is not None
+                          else Telemetry.from_config(cfg))
+        self.model = get_model(cfg.model)
+
+        data_seed = (cfg.data_seed if cfg.data_seed is not None
+                     else cfg.random_seed)
+        train_np = get_dataset(cfg.data_name, "train", cfg.train_size,
+                               data_seed)
+        self.test_np = get_dataset(cfg.data_name, "test", cfg.test_size,
+                                   data_seed)
+        self.train_data = {k: jnp.asarray(v) for k, v in train_np.items()}
+
+        # ---- shared programs -------------------------------------------
+        # branch order = the grid's batched defenses in grid order; the
+        # per-cell defense_idx arrays index into this ONE list
+        self.branch_modes = tuple(d for d in grid.defenses
+                                  if defense_group(d) == "batched")
+        branches = build_defense_branches(
+            self.model, cfg, self.test_np, self.branch_modes)
+
+        eval_fn = None
+        self.validation = None
+        if cfg.validation:
+            self.validation = Validation(
+                self.model, cfg.data_name, self.test_np, telemetry=None)
+            eval_fn = self.validation.eval_fn
+
+        # cohort geometry is shared (GridSpec pins the attacker count)
+        probe_cfg = cfg.replace(attacks=(grid.attacks[0],))
+        probe_groups, self.genuine_idx = build_attack_groups(probe_cfg)
+        self.genuine_mask, self.attacker_mask = build_cohort_masks(
+            cfg.total_clients, probe_groups)
+        self.num_genuine = len(self.genuine_idx)
+
+        # ---- in-graph numerics (per-cell rings) ------------------------
+        self._numerics = None
+        self._numerics_step_raw = None
+        self._numerics_on = bool(self.telemetry.enabled
+                                 and cfg.telemetry.numerics)
+        if self._numerics_on:
+            template = jax.eval_shape(
+                lambda key: self.model.init(
+                    key, *_sample_inputs(cfg.data_name))["params"],
+                jax.random.key(cfg.random_seed, impl=cfg.prng_impl))
+            layout = num_metrics.build_layout(template, True)
+            self._numerics = num_metrics.Numerics(
+                layout, self.genuine_mask, self.attacker_mask,
+                window=cfg.telemetry.numerics_window)
+            numerics = self._numerics
+
+            def numerics_step(num_state, old_ref, new_ref, stacked, sizes,
+                              loss, ok, broadcast):
+                return numerics.step(num_state, old_ref, old_ref, new_ref,
+                                     stacked, sizes, loss, ok, broadcast)
+
+            self._numerics_step_raw = numerics_step
+
+        # ---- compile groups (attack-major, deterministic order) --------
+        # group name -> {"body", "kind", "defense_idx", "cells"}
+        self.groups: dict[str, dict[str, Any]] = {}
+        for attack in grid.attacks:
+            acfg = cfg.replace(attacks=(attack,))
+            agroups, _ = build_attack_groups(acfg)
+            round_step = build_round_step(
+                self.model, acfg, self.train_data, agroups,
+                self.genuine_idx, None, None, mesh=None)
+            batched = [c for c in self.device_cells
+                       if c.attack == attack and c.group == "batched"]
+            mapped = [c for c in self.device_cells
+                      if c.attack == attack and c.group == "mapped"]
+            if batched:
+                self.groups[f"{attack.mode}:batched"] = {
+                    "kind": "batched",
+                    "cells": batched,
+                    "defense_idx": jnp.asarray(
+                        [self.branch_modes.index(c.defense)
+                         for c in batched], jnp.int32),
+                    "body": self._frozen(build_cell_body(
+                        round_step, branches, cfg.total_clients, eval_fn,
+                        cfg.validation_every, self._numerics_step_raw)),
+                }
+            if mapped:
+                # FLTrust: single static branch, sequential lax.map slices
+                fl_branch = build_defense_branches(
+                    self.model, cfg, self.test_np, (mapped[0].defense,))
+                self.groups[f"{attack.mode}:mapped"] = {
+                    "kind": "mapped",
+                    "cells": mapped,
+                    "defense_idx": None,
+                    "body": self._frozen(build_cell_body(
+                        round_step, fl_branch, cfg.total_clients, eval_fn,
+                        cfg.validation_every, self._numerics_step_raw)),
+                }
+        self._matrix_body = build_matrix_body(self.groups)
+        # jitted chunk programs keyed by (scan length, donate) — the
+        # attribute NAME matches the engine's so the retrace guard
+        # (analysis/retrace.jitted_programs) picks the cache up as-is
+        self._fused_cache: dict[tuple, Callable] = {}
+
+        # ---- persistence ------------------------------------------------
+        # restored sweeps keep donation OFF (jax 0.4.37 latch — see the
+        # engine's _state_donation_ok note)
+        self._state_donation_ok = True
+        self._resumed = False
+        # set by run(): True when a stop hook cut the sweep short (the
+        # service's requeue signal — byte-identical resume picks it up)
+        self.interrupted = False
+        # quarantined cells: exceeded the per-cell retry budget (e.g. a
+        # NaN-poisoned trajectory that can never recover) — they stop
+        # counting toward sweep progress and their records say so, but
+        # one toxic cell never kills the other 44 cells' science
+        self._aborted: set[str] = set()
+        os.makedirs(cfg.checkpoint_dir or ".", exist_ok=True)
+        self._ckpt_manager = ckpt.CheckpointManager(
+            os.path.join(cfg.checkpoint_dir or ".", MATRIX_STATE_FILE),
+            fingerprint=self.sweep_fingerprint(),
+            run_id=self.telemetry.events.run_id,
+            keep=cfg.checkpoint_keep,
+            telemetry=self.telemetry,
+            fresh=not cfg.resume,
+        )
+
+        # ---- cross-run ledger (per-cell records) ------------------------
+        self._ledger = None
+        if self.telemetry.enabled and cfg.telemetry.ledger:
+            from attackfl_tpu.ledger.store import (
+                LedgerStore, resolve_ledger_dir,
+            )
+
+            self._ledger = LedgerStore(resolve_ledger_dir(
+                cfg.telemetry.ledger_dir or None,
+                base=self.telemetry.base_dir))
+
+        # per-cell numerics drainers, lazily built at first resolve
+        self._drainers: dict[str, NumericsDrainer] = {}
+
+    # ------------------------------------------------------------------
+    # identity
+    # ------------------------------------------------------------------
+
+    def sweep_fingerprint(self) -> str:
+        """Checkpoint/resume identity: the base config fingerprint plus
+        the grid geometry (a resumed sweep must be the SAME sweep)."""
+        import hashlib
+
+        blob = (config_fingerprint(self.cfg) + "|"
+                + repr(self.grid.describe()))
+        return "matrix-" + hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+
+    def _cell_host_state(self, seed: int) -> dict[str, Any]:
+        """One cell's fresh state — the engine's ``_init_host_state``
+        (plain branch), field for field, so cell init == standalone
+        init."""
+        rng = jax.random.key(seed, impl=self.cfg.prng_impl)
+        k_model, k_state = jax.random.split(rng)
+        params = self.model.init(
+            k_model, *_sample_inputs(self.cfg.data_name))["params"]
+        prev_genuine = pt.tree_broadcast(
+            jax.tree.map(jnp.zeros_like, params), self.num_genuine)
+        state = {
+            "global_params": params,
+            "prev_genuine": prev_genuine,
+            "have_genuine": jnp.asarray(False),
+            "rng": k_state,
+            "completed_rounds": jnp.asarray(0, jnp.int32),
+            "broadcasts": jnp.asarray(0, jnp.int32),
+        }
+        if self._numerics is not None:
+            state["numerics"] = self._numerics.init_state()
+        return state
+
+    def init_state(self) -> dict[str, Any]:
+        """The grid state: per compile group, every cell's state stacked
+        on the leading axis (cell init happens UNBATCHED, so slice 0 of
+        the stack is byte-equal to the standalone init)."""
+        out: dict[str, Any] = {}
+        for name, group in self.groups.items():
+            per_cell = [self._cell_host_state(c.seed)
+                        for c in group["cells"]]
+            out[name] = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *per_cell)
+        return out
+
+    def _strip_numerics(self, state: dict[str, Any]) -> dict[str, Any]:
+        return {name: {k: v for k, v in sub.items() if k != "numerics"}
+                for name, sub in state.items()}
+
+    def _ensure_numerics(self, state: dict[str, Any]) -> dict[str, Any]:
+        if self._numerics is None:
+            return state
+        out = {}
+        for name, sub in state.items():
+            if "numerics" not in sub:
+                n = len(self.groups[name]["cells"])
+                ring = self._numerics.init_state()
+                sub = dict(sub, numerics=jax.tree.map(
+                    lambda leaf: jnp.stack([leaf] * n), ring))
+            out[name] = sub
+        return out
+
+    def load_or_init_state(self) -> dict[str, Any]:
+        """Fresh grid state, or — under ``cfg.resume`` — the newest
+        hash-valid checkpoint entry (torn entries fall back, exactly the
+        engine's resume semantics), with donation latched off."""
+        if not self.cfg.resume:
+            return self.init_state()
+        template = self._strip_numerics(self.init_state())
+        result = self._ckpt_manager.load_latest(template)
+        if result.state is None:
+            print_with_color(
+                "[matrix] no valid sweep checkpoint; starting fresh",
+                "yellow")
+            return self.init_state()
+        for entry, reason in result.rejected:
+            self.telemetry.counters.inc("checkpoint_fallbacks")
+            print_with_color(
+                f"[matrix] rejected checkpoint {entry.get('file')}: "
+                f"{reason[:120]}", "yellow")
+        self._state_donation_ok = False
+        self._resumed = True
+        self.telemetry.events.emit(
+            "matrix", sweep_id=self.sweep_id, action="resumed",
+            round=int(result.entry.get("round", 0))
+            if result.entry else 0)
+        return self._ensure_numerics(result.state)
+
+    # ------------------------------------------------------------------
+    # programs
+    # ------------------------------------------------------------------
+
+    def _frozen(self, body: Callable) -> Callable:
+        """Freeze a cell once it reaches the sweep's round target: the
+        whole cell state rides a ``where`` select, so straggler cells
+        (retrying failed rounds) never advance finished ones past their
+        standalone-final state."""
+        target = jnp.asarray(self.grid.rounds, jnp.int32)
+
+        def frozen(state, defense_idx):
+            done = state["completed_rounds"] >= target
+            new_state, metrics = body(state, defense_idx)
+            kept = jax.tree.map(
+                lambda new, old: jnp.where(done, old, new),
+                new_state, state)
+            metrics["active"] = ~done
+            return kept, metrics
+
+        return frozen
+
+    def _matrix_chunk(self, length: int, donate: bool) -> Callable:
+        key = (length, donate)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            self.telemetry.counters.inc("round_program_cache_misses")
+            body = self._matrix_body
+
+            def chunk(state):
+                return jax.lax.scan(body, state, None, length=length)
+
+            fn = jax.jit(chunk, donate_argnums=(0,) if donate else ())
+            self._fused_cache[key] = fn
+        else:
+            self.telemetry.counters.inc("round_program_cache_hits")
+        return fn
+
+    # ------------------------------------------------------------------
+    # audit hooks (attackfl_tpu/analysis)
+    # ------------------------------------------------------------------
+
+    def audit_programs(self, state: dict[str, Any] | None = None
+                       ) -> list[dict[str, Any]]:
+        """The batched grid program for the jaxpr/HLO auditor — same
+        contract as ``Simulator.audit_programs``."""
+        state = self._ensure_numerics(
+            state if state is not None else self.init_state())
+
+        def step(s):
+            return self._matrix_body(s, None)
+
+        return [dict(
+            name=f"matrix_step[{len(self.device_cells)} cells]",
+            executor="matrix", raw=step,
+            jit=jax.jit(step, donate_argnums=(0,)), args=(state,),
+            donate=(0,))]
+
+    # ------------------------------------------------------------------
+    # run
+    # ------------------------------------------------------------------
+
+    def _emit_header(self) -> None:
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        tel.events.emit(
+            "run_header",
+            backend=jax.default_backend(),
+            num_devices=len(jax.devices()),
+            mode="matrix",
+            model=self.cfg.model,
+            data_name=self.cfg.data_name,
+            total_clients=self.cfg.total_clients,
+            jax_version=jax.__version__,
+            platform=jax.devices()[0].platform,
+            sweep_id=self.sweep_id,
+            grid=self.grid.describe(),
+            config=dataclasses.asdict(self.cfg),
+        )
+
+    def _resolve_chunk(self, metrics: Any, length: int,
+                       histories: dict[str, list[dict[str, Any]]],
+                       consecutive: dict[str, int]) -> None:
+        """THE sweep's audited device->host materialization: one batched
+        copy of the chunk's metrics covers every cell × round in the
+        dispatch (per-cell numerics rows ride it — zero extra syncs).
+        Frozen-cell rounds (``active`` False) and quarantined cells are
+        skipped: the former already hold their standalone-final state,
+        the latter stopped being science."""
+        host = {name: {k: np.asarray(v) for k, v in group.items()}
+                for name, group in metrics.items()}
+        for name, group in self.groups.items():
+            data = host[name]
+            numerics_rows = data.pop("numerics_row", None)
+            for j, cell in enumerate(group["cells"]):
+                if cell.key in self._aborted:
+                    continue
+                history = histories.setdefault(cell.key, [])
+                for i in range(length):
+                    if not bool(data["active"][i, j]):
+                        continue
+                    entry = {
+                        k: (bool(v[i, j]) if k in ("ok", "active")
+                            else float(v[i, j]))
+                        for k, v in data.items()}
+                    entry.pop("active", None)
+                    entry["round"] = len(history) + 1
+                    entry["cell"] = cell.key
+                    history.append(entry)
+                    if entry["ok"]:
+                        consecutive[cell.key] = 0
+                    else:
+                        consecutive[cell.key] = \
+                            consecutive.get(cell.key, 0) + 1
+                        self.telemetry.counters.inc("rounds_failed")
+                    if numerics_rows is not None:
+                        self._drainer_for(cell).push_host_row(
+                            entry["round"], entry["round"],
+                            numerics_rows[i, j])
+
+    def _drainer_for(self, cell: Cell) -> NumericsDrainer:
+        drainer = self._drainers.get(cell.key)
+        if drainer is None:
+            drainer = NumericsDrainer(
+                self._numerics.layout,
+                _CellTelemetry(self.telemetry, cell.key),
+                self.cfg.telemetry.numerics_window)
+            self._drainers[cell.key] = drainer
+        return drainer
+
+    def _min_completed(self, state: dict[str, Any]) -> int:
+        """The sweep's progress gate: the minimum completed-round count
+        over the LIVE device cells (quarantined cells are excluded — a
+        cell that can never succeed must not wedge the other 44)."""
+        values = [int(v) for name in self.groups
+                  for cell, v in zip(
+                      self.groups[name]["cells"],
+                      np.asarray(state[name]["completed_rounds"]))
+                  if cell.key not in self._aborted]
+        return min(values) if values else self.grid.rounds
+
+    def _save_checkpoint(self, state: dict[str, Any],
+                         completed: int) -> None:
+        target = self._strip_numerics(state)
+        self._ckpt_manager.write(
+            os.path.join(self.cfg.checkpoint_dir or ".", MATRIX_STATE_FILE),
+            ckpt.host_state(target),
+            {"round": completed, "broadcast": completed})
+
+    def run(self, stop: Callable[[int], bool] | None = None,
+            save_checkpoints: bool = True, verbose: bool = True
+            ) -> tuple[dict[str, Any], dict[str, list[dict[str, Any]]]]:
+        """Run the sweep to completion (or a graceful ``stop``).
+
+        Returns ``(final_params, histories)``: per cell key, the final
+        global params tree and the per-round history.  ``stop`` is
+        consulted between chunks and between fallback cells — the
+        service's drain seam."""
+        cfg = self.cfg
+        tel = self.telemetry
+        t_start = time.perf_counter()
+        self._emit_header()
+        tel.events.emit("matrix", sweep_id=self.sweep_id, action="started",
+                        grid=self.grid.describe(),
+                        device_cells=len(self.device_cells),
+                        fallback_cells=len(self.fallback_cells),
+                        resumed=self._resumed)
+        state = self.load_or_init_state()
+        histories: dict[str, list[dict[str, Any]]] = {}
+        consecutive: dict[str, int] = {}
+        interrupted = False
+        first_dispatch = True
+        completed = self._min_completed(state) if self.groups else 0
+
+        try:
+            while self.groups and completed < self.grid.rounds:
+                if stop is not None and stop(completed):
+                    interrupted = True
+                    break
+                remaining = self.grid.rounds - completed
+                cap = self.grid.chunk
+                if first_dispatch or remaining >= cap:
+                    n = min(cap, remaining)
+                else:
+                    n = 1  # retry tails reuse one length-1 program
+                first_dispatch = False
+                donate = self._state_donation_ok
+                includes_compile = (n, donate) not in self._fused_cache
+                t0 = time.perf_counter()
+                with tel.tracer.span("chunk", chunk_len=n, matrix=True):
+                    state, metrics = self._matrix_chunk(n, donate)(state)
+                    # the np.asarray inside _resolve_chunk IS the block:
+                    # dispatch is async, so timing must enclose the
+                    # materialization (run_fast's lesson)
+                    self._resolve_chunk(metrics, n, histories, consecutive)
+                elapsed = time.perf_counter() - t0
+                completed = self._min_completed(state)
+                tel.events.emit(
+                    "matrix", sweep_id=self.sweep_id, action="chunk",
+                    chunk_len=n, seconds=round(elapsed, 6),
+                    includes_compile=includes_compile,
+                    min_completed=completed)
+                for key, failures in list(consecutive.items()):
+                    if failures > MAX_CELL_RETRIES and \
+                            key not in self._aborted:
+                        # quarantine, don't kill: the standalone run
+                        # would abort HERE (run_fast's retry cap) — the
+                        # sweep records that verdict per cell and keeps
+                        # the other cells' science alive
+                        self._aborted.add(key)
+                        tel.counters.inc("matrix_cells_aborted")
+                        tel.events.emit(
+                            "matrix", sweep_id=self.sweep_id,
+                            action="cell_aborted", cell=key,
+                            consecutive_failures=failures)
+                        print_with_color(
+                            f"[matrix] cell {key} failed {failures} "
+                            "rounds in a row — quarantined (the "
+                            "standalone run would abort here); the "
+                            "sweep continues", "red")
+                completed = self._min_completed(state)
+                if save_checkpoints:
+                    self._save_checkpoint(state, completed)
+                if verbose:
+                    print_with_color(
+                        f"[matrix] {completed}/{self.grid.rounds} rounds "
+                        f"x {len(self.device_cells)} device cells, chunk "
+                        f"of {n} in {elapsed:.2f}s", "green")
+
+            final_params = self._slice_final_params(state)
+
+            if not interrupted:
+                interrupted = self._run_fallback_cells(
+                    final_params, histories, stop)
+        finally:
+            self.interrupted = interrupted
+            self._finish(histories, t_start, interrupted)
+        return final_params, histories
+
+    def _slice_final_params(self, state: dict[str, Any]
+                            ) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for name, group in self.groups.items():
+            stacked = state[name]["global_params"]
+            for j, cell in enumerate(group["cells"]):
+                out[cell.key] = jax.tree.map(lambda leaf: leaf[j], stacked)
+        return out
+
+    # ------------------------------------------------------------------
+    # fallback cells (host defenses / hyper)
+    # ------------------------------------------------------------------
+
+    def _cell_dir(self, cell: Cell) -> str:
+        return os.path.join(self.cfg.checkpoint_dir or ".", "cells",
+                            cell.key)
+
+    def _fallback_config(self, cell: Cell) -> Config:
+        cell_dir = self._cell_dir(cell)
+        telemetry = dataclasses.replace(
+            self.cfg.telemetry,
+            events_path=os.path.join(cell_dir, "events.jsonl"),
+            trace_path=os.path.join(cell_dir, "trace.json"),
+            monitor=False,
+            # one ledger record per cell comes from the SWEEP's
+            # distillation — the child must not double-append
+            ledger=False,
+        )
+        return cell_config(self.cfg, cell, rounds=self.grid.rounds,
+                           log_path=cell_dir, checkpoint_dir=cell_dir,
+                           telemetry=telemetry,
+                           resume=self._resumed)
+
+    def _run_fallback_cells(self, final_params: dict[str, Any],
+                            histories: dict[str, list[dict[str, Any]]],
+                            stop: Callable[[int], bool] | None) -> bool:
+        """Per-cell fallback runs.  Returns True when stopped early."""
+        from attackfl_tpu.training.engine import Simulator
+
+        for cell in self.fallback_cells:
+            if stop is not None and stop(self.grid.rounds):
+                return True
+            os.makedirs(self._cell_dir(cell), exist_ok=True)
+            if cell.group == "host":
+                print_with_color(
+                    f"[matrix] defense '{cell.defense}' filters on host — "
+                    f"cell {cell.key} falls back to a per-cell "
+                    "synchronous run", "yellow")
+            self.telemetry.events.emit(
+                "matrix", sweep_id=self.sweep_id, action="fallback",
+                cell=cell.key, group=cell.group)
+            sim = Simulator(self._fallback_config(cell))
+            sim.header_extra = {"sweep_id": self.sweep_id,
+                                "cell": cell.key}
+            try:
+                if sim.supports_fused():
+                    # per-cell specialization: the cell's own compiled
+                    # fused program (hyper without detection)
+                    state, history = sim.run_fast(verbose=False, stop=stop)
+                else:
+                    state, history = sim.run(verbose=False, stop=stop)
+            finally:
+                sim.close()
+            key = ("hnet_params" if "hnet_params" in state
+                   else "global_params")
+            final_params[cell.key] = state[key]
+            for entry in history:
+                entry["cell"] = cell.key
+            histories[cell.key] = history
+            self.telemetry.events.emit(
+                "matrix", sweep_id=self.sweep_id, action="cell_done",
+                cell=cell.key,
+                rounds=len(history),
+                ok_rounds=sum(1 for h in history if h.get("ok")))
+            if int(state["completed_rounds"]) < self.grid.rounds:
+                return True  # the stop hook cut this cell short
+        return False
+
+    # ------------------------------------------------------------------
+    # terminal work
+    # ------------------------------------------------------------------
+
+    def _finish(self, histories: dict[str, list[dict[str, Any]]],
+                t_start: float, interrupted: bool) -> None:
+        tel = self.telemetry
+        wall = time.perf_counter() - t_start
+        self._append_ledger_records(histories, wall)
+        if tel.enabled:
+            tel.events.emit(
+                "matrix", sweep_id=self.sweep_id,
+                action="interrupted" if interrupted else "completed",
+                cells_done=len(histories), seconds=round(wall, 6))
+            tel.events.emit("counters", counters=tel.counters.snapshot())
+            total = sum(len(h) for h in histories.values())
+            tel.events.emit(
+                "run_end", rounds=total,
+                ok_rounds=sum(1 for h in histories.values()
+                              for e in h if e.get("ok")),
+                seconds=round(wall, 6))
+            tel.flush()
+
+    def _append_ledger_records(self,
+                               histories: dict[str, list[dict[str, Any]]],
+                               wall: float) -> None:
+        if self._ledger is None or not histories:
+            return
+        try:
+            records = sweep_records(
+                sweep_id=self.sweep_id, cells=self.cells,
+                histories=histories, base_cfg=self.cfg,
+                rounds=self.grid.rounds,
+                run_id=self.telemetry.events.run_id,
+                ts=time.time(), wall_s=wall, resumed=self._resumed,
+                provenance={"jax_version": jax.__version__,
+                            "backend": jax.default_backend()})
+            for record in records:
+                self._ledger.append(record)
+            self.telemetry.counters.inc("ledger_records_appended",
+                                        len(records))
+        except Exception as e:  # noqa: BLE001 — observability, fail open
+            self.telemetry.counters.inc("ledger_append_failures")
+            print_with_color(
+                f"[matrix] ledger append failed (sweep unaffected): "
+                f"{type(e).__name__}: {e}", "yellow")
+
+    def close(self) -> None:
+        self.telemetry.close()
+
+
+def _sample_inputs(data_name: str):
+    from attackfl_tpu.training.engine import sample_inputs
+
+    return sample_inputs(data_name)

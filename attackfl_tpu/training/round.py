@@ -423,6 +423,24 @@ def build_aggregator(
     return aggregate
 
 
+def build_defense_branches(
+    model,
+    cfg: Config,
+    test_data: Batch | None,
+    modes: Sequence[str],
+) -> list[Callable]:
+    """Uniform-signature aggregate branches for the scenario matrix's
+    ``lax.switch`` defense dispatch (ISSUE 9): one
+    ``(global_params, stacked, sizes, weights_mask, rng) -> new_global``
+    per mode, each built by :func:`build_aggregator` under the base
+    config with only the mode swapped — the same defense knobs
+    (krum_f, trim_ratio, byzantine_threshold) every standalone run of
+    that mode reads, so a switched branch and a standalone aggregate are
+    the same program."""
+    return [build_aggregator(model, cfg.replace(mode=mode), test_data)
+            for mode in modes]
+
+
 def build_attribution_fn(
     model,
     cfg: Config,

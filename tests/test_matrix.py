@@ -1,0 +1,541 @@
+"""Scenario matrix engine (ISSUE 9): grid expansion, per-cell parity,
+chaos resume, program audits, ledger records, schema v7.
+
+The load-bearing guarantee is **per-cell bit-identity**: every matrix
+cell's final params equal a standalone run of its
+:func:`~attackfl_tpu.matrix.grid.cell_config` byte for byte, across the
+sync and fused standalone executors.  Everything else (chunking, the
+freeze select, resume, ledger distillation) is audited against that
+contract.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from attackfl_tpu.config import (  # noqa: E402
+    AttackSpec, TelemetryConfig, audit_config,
+)
+from attackfl_tpu.matrix.grid import (  # noqa: E402
+    BATCHED_DEFENSES, Cell, GridSpec, cell_config, expand_cells,
+    grid_from_dict,
+)
+from attackfl_tpu.training.engine import Simulator  # noqa: E402
+from attackfl_tpu.training.matrix_exec import MatrixRun  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _base(tmp_path, **kw):
+    defaults = dict(
+        prng_impl="threefry2x32",
+        telemetry=TelemetryConfig(enabled=False),
+        log_path=str(tmp_path), checkpoint_dir=str(tmp_path),
+    )
+    defaults.update(kw)
+    return audit_config(**defaults)
+
+
+def _grid(**kw):
+    defaults = dict(
+        attacks=(AttackSpec(mode="LIE", num_clients=1, attack_round=2),
+                 AttackSpec(mode="Random", num_clients=1, attack_round=2,
+                            args=(0.5,))),
+        defenses=("fedavg", "krum", "FLTrust"),
+        seeds=(1, 2),
+        rounds=3, chunk=2,
+    )
+    defaults.update(kw)
+    return GridSpec(**defaults)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+        for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# grid spec
+# ---------------------------------------------------------------------------
+
+def test_grid_expansion_order_and_groups():
+    grid = _grid(defenses=("fedavg", "FLTrust", "gmm", "hyper"))
+    cells = expand_cells(grid)
+    assert len(cells) == 2 * 4 * 2 == grid.n_cells
+    # attack-major, then defense, then seed — the deterministic order
+    assert [c.key for c in cells[:4]] == [
+        "LIExfedavg.s1", "LIExfedavg.s2",
+        "LIExFLTrust.s1", "LIExFLTrust.s2"]
+    groups = {c.defense: c.group for c in cells}
+    assert groups == {"fedavg": "batched", "FLTrust": "mapped",
+                      "gmm": "host", "hyper": "special"}
+
+
+def test_grid_from_dict_shorthand_and_validation(tmp_path):
+    grid = grid_from_dict({
+        "attacks": ["LIE", {"mode": "Min-Max", "num-clients": 1,
+                            "attack-round": 3, "args": [50, 1]}],
+        "attack-clients": 1, "defenses": ["fedavg", "median"],
+        "seeds": [1, 2, 3], "rounds": 5, "chunk": 2})
+    assert [a.mode for a in grid.attacks] == ["LIE", "Min-Max"]
+    assert grid.attacks[1].attack_round == 3
+    assert grid.n_cells == 12
+    with pytest.raises(ValueError, match="defense"):
+        GridSpec(attacks=grid.attacks, defenses=("nonsense",), seeds=(1,))
+    with pytest.raises(ValueError, match="same number"):
+        GridSpec(attacks=(AttackSpec(mode="LIE", num_clients=1),
+                          AttackSpec(mode="Random", num_clients=2)),
+                 defenses=("fedavg",), seeds=(1,))
+    # the parity-contract preconditions
+    base = _base(tmp_path)
+    with pytest.raises(ValueError, match="threefry"):
+        grid.validate_base(base.replace(prng_impl="rbg"))
+    with pytest.raises(ValueError, match="iid"):
+        grid.validate_base(base.replace(partition="dirichlet"))
+
+
+def test_cell_config_pins_data_seed(tmp_path):
+    base = _base(tmp_path)
+    cell = Cell(AttackSpec(mode="LIE", num_clients=1), "krum", 7)
+    cfg = cell_config(base, cell, rounds=4)
+    assert cfg.mode == "krum" and cfg.random_seed == 7
+    assert cfg.num_round == 4
+    # the seed axis varies the simulation stream only: the dataset stays
+    # the sweep's (data_seed = the base seed)
+    assert cfg.data_seed == base.random_seed
+    assert cfg.attacks == (cell.attack,)
+
+
+# ---------------------------------------------------------------------------
+# per-cell parity: the tentpole contract
+# ---------------------------------------------------------------------------
+
+def test_matrix_parity_bit_identical(tmp_path, capsys):
+    """Cells of a (LIE × [fedavg, krum, FLTrust, gmm] × 2 seeds) grid
+    end bit-identical to standalone runs of their cell configs — one
+    sweep covering every execution mechanism: the switch-batched
+    defenses (fedavg, krum), the lax.map FLTrust path, the gmm host
+    fallback (with its warning), both seeds; sync-executor checks per
+    mechanism.  Fused-executor parity follows by transitivity (matrix
+    == sync here; sync == fused is pinned broadly by the existing
+    bit-identity suites — test_pipeline / test_fused / test_numerics).
+    The gmm fallback cell's params come from the SAME Simulator.run
+    code path a standalone run takes (only its working directory
+    differs), so the load-bearing comparisons are the batched and
+    mapped cells'.  The 2-attack grid expansion is covered by the audit
+    program (scripts/audit.sh) and the slow-marked 5×9×2 acceptance
+    test."""
+    base = _base(tmp_path / "m")
+    grid = _grid(attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                     attack_round=2),),
+                 defenses=("fedavg", "krum", "FLTrust", "gmm"),
+                 chunk=3)  # rounds == chunk: ONE compiled program
+    runner = MatrixRun(base, grid)
+    final, histories = runner.run(verbose=False, save_checkpoints=False)
+    runner.close()
+    assert "falls back to a per-cell" in capsys.readouterr().out
+    cells = expand_cells(grid)
+    assert set(final) == {c.key for c in cells}
+    assert all(len(histories[c.key]) >= 3 for c in cells)
+
+    by_key = {c.key: c for c in cells}
+    # one sync check per device mechanism, both seeds covered
+    sync_checked = ["LIExfedavg.s1", "LIExkrum.s2", "LIExFLTrust.s1"]
+    for i, key in enumerate(sync_checked):
+        cell = by_key[key]
+        ccfg = cell_config(_base(tmp_path / f"c{i}"), cell, rounds=3)
+        sim = Simulator(ccfg)
+        state, hist = sim.run(num_rounds=3, save_checkpoints=False,
+                              verbose=False)
+        assert _leaves_equal(final[cell.key], state["global_params"]), \
+            f"cell {cell.key} diverged from its standalone sync run"
+        assert len(histories[cell.key]) == len(hist)
+
+
+# ---------------------------------------------------------------------------
+# chaos: die mid-sweep, resume, byte-identical grid
+# ---------------------------------------------------------------------------
+
+def test_matrix_kill_and_resume_byte_identical_grid(tmp_path):
+    """Stop a sweep after its first chunk (simulated death: the stop
+    hook plus a TORN newest checkpoint entry + an orphaned temp —
+    the kill -9 debris pattern from tests/test_faults), resume, and the
+    final grid is byte-identical to an uninterrupted sweep."""
+    grid = _grid(attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                     attack_round=2),),
+                 defenses=("fedavg",), seeds=(1, 2),
+                 rounds=3, chunk=1)  # chunk=1: one entry per round
+
+    # uninterrupted reference
+    ref = MatrixRun(_base(tmp_path / "ref"), grid)
+    ref_final, _ = ref.run(verbose=False)
+    ref.close()
+
+    # interrupted: stop once two rounds completed
+    work = tmp_path / "work"
+    first = MatrixRun(_base(work), grid)
+    first_final, _ = first.run(verbose=False,
+                               stop=lambda completed: completed >= 2)
+    assert first.interrupted
+    first.close()
+    # death debris: tear the newest round-stamped entry, orphan a temp —
+    # resume must fall back to the previous good entry
+    entries = sorted(work.glob("matrix.r*.msgpack"))
+    assert entries, "sweep checkpoints missing"
+    with open(entries[-1], "r+b") as fh:
+        fh.truncate(64)
+    (work / "matrix.msgpack.tmp").write_bytes(b"junk")
+
+    resumed = MatrixRun(_base(work, resume=True), grid)
+    res_final, _ = resumed.run(verbose=False)
+    assert not resumed.interrupted
+    resumed.close()
+
+    for key, params in ref_final.items():
+        assert _leaves_equal(params, res_final[key]), \
+            f"cell {key} not byte-identical after resume"
+
+
+# ---------------------------------------------------------------------------
+# program audits: jaxpr auditor (the retrace guard rides the ledger test)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_matrix_program_passes_jaxpr_auditor():
+    """Zero callback/transfer primitives, donation aliasing as declared,
+    no f64 — the same bar every single-run executor meets.  Slow-marked:
+    tier-1 already runs this exact audit through scripts/audit.sh
+    (tests/test_audit.py), so the dedicated test only adds depth when
+    run explicitly."""
+    from attackfl_tpu.analysis.program_audit import audit_matrix_program
+
+    reports = audit_matrix_program()
+    assert reports and all(r.executor == "matrix" for r in reports)
+    for report in reports:
+        assert report.ok, report.problems
+        assert report.forbidden == []
+        assert report.f64_outputs == 0
+        assert report.aliased_leaves == report.expected_aliases > 0
+
+
+# ---------------------------------------------------------------------------
+# ledger: per-cell records + cell-aware baselines (satellite); the same
+# sweep feeds the retrace guard (zero post-warmup jit-cache growth)
+# ---------------------------------------------------------------------------
+
+def test_matrix_ledger_records_share_sweep_id(tmp_path, monkeypatch):
+    from attackfl_tpu.analysis.retrace import RetraceGuard
+    from attackfl_tpu.ledger.record import validate_record
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    base = _base(tmp_path, telemetry=TelemetryConfig(enabled=True))
+    grid = _grid(attacks=(AttackSpec(mode="LIE", num_clients=1,
+                                     attack_round=2),),
+                 defenses=("fedavg",), seeds=(1, 2), rounds=2)
+    runner = MatrixRun(base, grid)
+    final, _ = runner.run(verbose=False, save_checkpoints=False)
+
+    # retrace guard: the sweep's program is warm — another chunk over a
+    # fresh grid state must add ZERO jit-cache entries
+    guard = RetraceGuard(runner)
+    guard.snapshot()
+    runner._matrix_chunk(2, donate=True)(
+        runner._ensure_numerics(runner.init_state()))
+    assert guard.violations() == []
+    runner.close()
+
+    store = LedgerStore(str(tmp_path / "ledger"))
+    records, skipped = store.load()
+    assert skipped == 0 and len(records) == grid.n_cells
+    keys = {r["cell"] for r in records}
+    assert keys == {c.key for c in expand_cells(grid)}
+    for record in records:
+        assert validate_record(record) == []
+        assert record["sweep_id"] == runner.sweep_id
+        assert record["source"] == "matrix"
+        assert record["executor"] == "matrix"
+        assert record["rounds"] == 2 and record["ok_rounds"] == 2
+        assert record["final"].get("train_loss") is not None
+    # per-cell fingerprints equal the standalone cell-config fingerprint
+    from attackfl_tpu.utils.fingerprint import config_fingerprint
+
+    cell = expand_cells(grid)[0]
+    expected = config_fingerprint(cell_config(base, cell, rounds=2))
+    by_cell = {r["cell"]: r for r in records}
+    assert by_cell[cell.key]["fingerprint"] == expected
+    # index carries the sweep/cell columns
+    entry = [e for e in store.index()
+             if e.get("cell") == cell.key][0]
+    assert entry["sweep_id"] == runner.sweep_id
+
+
+def test_rolling_baseline_respects_cell_identity():
+    """The satellite regression: records with IDENTICAL fingerprints but
+    different (attack, defense, seed) cells must not pool into one
+    baseline."""
+    from attackfl_tpu.ledger.compare import regress_check, rolling_baseline
+
+    def record(cell, rate, rid):
+        return {"record_id": rid, "fingerprint": "fp-shared",
+                "executor": "matrix", "cell": cell,
+                "rounds_per_sec_steady": rate, "final": {},
+                "counts": {}, "time_attribution": {}}
+
+    history = [record("LIExfedavg.s1", 10.0, f"a{i}") for i in range(4)] \
+        + [record("LIExkrum.s1", 2.0, f"b{i}") for i in range(4)]
+    candidate = record("LIExfedavg.s1", 9.8, "cand")
+    baseline = rolling_baseline(history, candidate)
+    assert baseline is not None
+    # peers are the fedavg cell's records ONLY: the baseline rate is 10,
+    # not a median contaminated by the 2.0-r/s krum cell
+    assert baseline["rounds_per_sec_steady"] == 10.0
+    assert set(baseline["baseline_of"]) == {"a0", "a1", "a2", "a3"}
+    assert regress_check(baseline, candidate)["ok"]
+
+    # a slow OTHER cell gates against its own history, not fedavg's
+    slow_candidate = record("LIExkrum.s1", 1.9, "cand2")
+    slow_baseline = rolling_baseline(history, slow_candidate)
+    assert slow_baseline["rounds_per_sec_steady"] == 2.0
+    assert regress_check(slow_baseline, slow_candidate)["ok"]
+    # and a real regression in one cell still fails
+    bad = record("LIExkrum.s1", 1.0, "cand3")
+    assert not regress_check(rolling_baseline(history, bad), bad)["ok"]
+    # non-matrix records (no cell key) keep matching each other
+    plain = [dict(record(None, 5.0, f"p{i}"), cell=None) for i in range(3)]
+    for r in plain:
+        r.pop("cell")
+    cand = dict(plain[0], record_id="pc")
+    assert rolling_baseline(plain, cand) is not None
+
+
+def test_bench_matrix_records_import(tmp_path):
+    """records_from_bench maps a --matrix-compare metric line to one
+    record per variant, and the committed BENCH_MATRIX.json imports."""
+    from attackfl_tpu.ledger.record import (
+        records_from_bench, validate_record,
+    )
+
+    line = {
+        "metric": "fl_matrix_vs_serial_sweep", "value": 3.0, "unit": "x",
+        "detail": {
+            "config": "matrix-compare: test",
+            "serial": {"rounds_per_sec_steady": 1.0, "per_rep": [1.0, 1.1],
+                       "warm_wall_s": 45.0, "cold_wall_s": 90.0},
+            "batched": {"rounds_per_sec_steady": 3.0, "per_rep": [3.0, 2.9],
+                        "warm_wall_s": 15.0, "cold_wall_s": 30.0},
+            "speedup_cold": 3.0, "speedup_warm": 3.0,
+            "compile_once_saving_s": 30.0,
+        },
+    }
+    records = records_from_bench(line)
+    assert [r["bench_variant"] for r in records] == ["serial", "batched"]
+    assert records[1]["executor"] == "matrix"
+    assert records[1]["compile_once_saving_s"] == 30.0
+    for record in records:
+        assert validate_record(record) == []
+
+    committed = REPO / "BENCH_MATRIX.json"
+    assert committed.exists(), "commit BENCH_MATRIX.json (bench.py " \
+                               "--matrix-compare)"
+    parsed = json.loads(committed.read_text())
+    records = records_from_bench(parsed)
+    assert {r["bench_variant"] for r in records} == {"serial", "batched"}
+    for record in records:
+        assert validate_record(record) == []
+    # the committed evidence shows the batched sweep winning cold
+    assert parsed["detail"]["speedup_cold"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# schema v7 + committed corpus
+# ---------------------------------------------------------------------------
+
+def test_v7_kinds_registered_and_older_schemas_unchanged():
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds, validate_event,
+    )
+
+    assert SCHEMA_VERSION == 7
+    assert KINDS_BY_VERSION[7] == frozenset({"matrix"})
+    assert "matrix" not in known_kinds(6)
+    assert "matrix" in known_kinds(7)
+    good = {"schema": 7, "kind": "matrix", "ts": 1.0, "run_id": "r",
+            "sweep_id": "s1", "action": "started"}
+    assert validate_event(good) == []
+    assert validate_event({**good, "sweep_id": 3}) != []
+    assert validate_event({"schema": 7, "kind": "matrix", "ts": 1.0,
+                           "action": "chunk"}) != []  # sweep_id required
+    header = {"schema": 7, "kind": "run_header", "ts": 1.0, "run_id": "r",
+              "backend": "cpu", "num_devices": 1, "mode": "matrix",
+              "model": "CNNModel", "data_name": "ICU",
+              "sweep_id": "s1", "cell": "LIExfedavg.s1"}
+    assert validate_event(header) == []
+    assert validate_event({**header, "cell": 7}) != []
+
+
+def test_v7_corpus_validates_and_exercises_matrix_kind():
+    from attackfl_tpu.telemetry.events import validate_event
+
+    path = REPO / "tests" / "data" / "events.v7.jsonl"
+    assert path.exists(), "commit events.v7.jsonl from a real sweep"
+    events = [json.loads(line) for line in path.read_text().splitlines()
+              if line.strip()]
+    assert events
+    for event in events:
+        assert validate_event(event) == [], event
+    kinds = {e["kind"] for e in events}
+    assert "matrix" in kinds and "run_header" in kinds
+    actions = {e["action"] for e in events if e["kind"] == "matrix"}
+    assert {"started", "chunk", "fallback", "cell_done",
+            "completed"} <= actions
+    header = [e for e in events if e["kind"] == "run_header"][0]
+    assert header.get("sweep_id")
+
+
+# ---------------------------------------------------------------------------
+# service: one sealed matrix job -> a grid of ledger records
+# ---------------------------------------------------------------------------
+
+def test_service_matrix_job(tmp_path, monkeypatch):
+    from attackfl_tpu.ledger.store import LedgerStore
+    from attackfl_tpu.service.queue import JobQueue
+    from attackfl_tpu.service.worker import JobWorker
+    from attackfl_tpu.telemetry import Telemetry
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path / "tel"))
+    (tmp_path / "tel").mkdir()
+    spool = tmp_path / "spool"
+    queue = JobQueue(str(spool / "queue"), depth=4)
+    spec = {
+        "type": "matrix",
+        "name": "sweep-test",
+        # job specs carry YAML-schema config dicts (the service wire
+        # format) — the worker isolates paths and forces threefry
+        "config": {
+            "server": {"num-round": 2, "clients": 4, "mode": "fedavg",
+                       "model": "CNNModel", "data-name": "ICU",
+                       "validation": False, "train-size": 256,
+                       "test-size": 128, "random-seed": 1,
+                       "data-distribution": {"num-data-range": [48, 64]}},
+            "learning": {"epoch": 1, "batch-size": 32},
+        },
+        "grid": {"attacks": ["LIE"], "attack-clients": 1,
+                 "attack-round": 2, "defenses": ["fedavg", "krum"],
+                 "seeds": [1], "rounds": 2},
+    }
+    job_id = queue.submit(spec)
+    job = queue.claim()
+    assert job is not None and job.job_id == job_id
+    ledger_dir = str(spool / "ledger")
+    worker = JobWorker(job, str(spool / "jobs" / job_id), ledger_dir,
+                       queue, Telemetry.disabled(), run_monitor=False)
+    worker.start()
+    worker.join(timeout=600)
+    assert not worker.is_alive()
+    assert worker.final_state == "done", worker.error
+    status = queue.get(job_id).status
+    assert status["state"] == "done"
+    assert status["result"]["completed"] == 2  # both cells
+    records, _ = LedgerStore(ledger_dir).load()
+    assert {r["cell"] for r in records} == {"LIExfedavg.s1", "LIExkrum.s1"}
+    assert len({r["sweep_id"] for r in records}) == 1
+
+
+def test_daemon_rejects_malformed_matrix_grid(tmp_path):
+    from attackfl_tpu.service.daemon import RunService
+
+    svc = RunService(str(tmp_path / "spool"), port=0)
+    try:
+        with pytest.raises(ValueError):
+            svc.submit({"type": "matrix",
+                        "grid": {"defenses": ["nonsense"]}})
+    finally:
+        svc.telemetry.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_matrix_status_cli(tmp_path, monkeypatch, capsys):
+    from attackfl_tpu.ledger.store import LedgerStore
+    from attackfl_tpu.matrix.cli import status_main
+
+    store = LedgerStore(str(tmp_path))
+    for cell in ("LIExfedavg.s1", "LIExkrum.s1"):
+        store.append({
+            "ledger_schema": 1, "source": "matrix", "executor": "matrix",
+            "fingerprint": "fp", "sweep_id": "sweepA", "cell": cell,
+            "rounds": 3, "ok_rounds": 3, "time_attribution": {},
+            "counts": {}, "final": {"roc_auc": 0.9, "train_loss": 0.1},
+            "ts": 1.0,
+        })
+    assert status_main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "sweepA" in out and "LIExkrum.s1" in out and "0.9000" in out
+    assert status_main(["--dir", str(tmp_path),
+                        "--sweep-id", "nope"]) == 2
+    assert status_main(["--dir", str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert len(payload) == 2
+
+
+def test_matrix_cli_usage():
+    from attackfl_tpu.matrix.cli import main
+
+    assert main(["--help"]) == 0
+    assert main(["nonsense"]) == 2
+    assert main([]) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the full 5x9x2 grid (slow — run explicitly)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_full_grid_5x9x2_one_program(tmp_path):
+    """The ISSUE 9 acceptance grid: 5 attacks × 9 defenses × 2 seeds.
+    The device portion (batched + FLTrust) compiles as ONE program, the
+    retrace guard sees zero post-warmup growth, and the program passes
+    the jaxpr auditor."""
+    from attackfl_tpu.analysis.program_audit import audit_program
+    from attackfl_tpu.analysis.retrace import RetraceGuard
+    from attackfl_tpu.config import ATTACK_MODES
+    from attackfl_tpu.matrix.grid import MAPPED_DEFENSES
+
+    base = _base(tmp_path)
+    grid = grid_from_dict({
+        "attacks": list(ATTACK_MODES), "attack-clients": 1,
+        "attack-round": 2,
+        "defenses": list(BATCHED_DEFENSES + MAPPED_DEFENSES + ("gmm",)),
+        "seeds": [1, 2], "rounds": 3, "chunk": 3})
+    assert grid.n_cells == 5 * 9 * 2
+    runner = MatrixRun(base, grid)
+    assert len(runner.device_cells) == 5 * 8 * 2
+    assert len(runner.fallback_cells) == 5 * 1 * 2
+
+    # jaxpr auditor over the one grid program
+    program = runner.audit_programs()[0]
+    report = audit_program(program["name"], program["executor"],
+                           program["raw"], program["jit"],
+                           program["args"], program["donate"])
+    assert report.ok, report.problems
+
+    # one compiled program: a single chunk signature serves the sweep
+    state = runner.load_or_init_state()
+    state, _ = runner._matrix_chunk(3, donate=False)(state)
+    guard = RetraceGuard(runner)
+    guard.snapshot()
+    state, _ = runner._matrix_chunk(3, donate=False)(state)
+    assert guard.violations() == []
+    assert len(runner._fused_cache) == 1
+    runner.close()

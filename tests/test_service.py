@@ -748,7 +748,7 @@ def test_v6_kinds_registered_and_older_schemas_unchanged():
         KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds,
     )
 
-    assert SCHEMA_VERSION == 6
+    assert SCHEMA_VERSION >= 6  # v7 (ISSUE 9) added the matrix kind
     assert KINDS_BY_VERSION[6] == frozenset({"job", "service"})
     assert not ({"job", "service"} & known_kinds(5))
     assert {"job", "service"} <= known_kinds(6)
