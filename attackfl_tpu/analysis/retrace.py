@@ -103,23 +103,30 @@ def run_with_guard(sim, num_rounds: int = 3, pipeline: bool = False,
 
 
 def guard_findings(modes_and_executors=(("fedavg", False),
-                                        ("fedavg", True))) -> list[Finding]:
+                                        ("fedavg", True),
+                                        ("fedavg", True, 4))
+                   ) -> list[Finding]:
     """CLI entry (``audit --retrace``): run the guard over the
     representative config on the sync and pipelined executors (the fused
-    executor shares the pipelined body).  EXECUTES rounds — seconds of
-    compile + train on CPU, unlike the purely static passes."""
+    executor shares the pipelined body), including a depth-4 pipelined
+    run — depth changes must dispatch the one cached step program
+    (ISSUE 10).  Entries are ``(mode, pipeline[, depth])``.  EXECUTES
+    rounds — seconds of compile + train on CPU, unlike the purely static
+    passes."""
     from attackfl_tpu.config import audit_config
     from attackfl_tpu.training.engine import Simulator
 
     findings = []
-    for mode, pipeline in modes_and_executors:
-        sim = Simulator(audit_config(mode=mode))
+    for entry in modes_and_executors:
+        mode, pipeline, depth = (*entry, 1)[:3]
+        sim = Simulator(audit_config(mode=mode, pipeline_depth=depth))
         try:
+            label = (f"pipelined[depth={depth}]" if pipeline else "sync")
             for problem in run_with_guard(sim, num_rounds=3,
                                           pipeline=pipeline):
                 findings.append(Finding(
                     rule="retrace-guard",
-                    file=f"<run:{mode}:{'pipelined' if pipeline else 'sync'}>",
+                    file=f"<run:{mode}:{label}>",
                     line=0, message=problem, hint=RETRACE_GUARD_HINT))
         finally:
             sim.close()

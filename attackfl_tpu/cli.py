@@ -146,9 +146,16 @@ def server_main(argv=None) -> None:
     parser.add_argument("--rounds", type=int, default=None, help="override num-round")
     # --- round-pipeline / persistence overrides (config: server: section) ---
     parser.add_argument("--pipeline", action="store_true",
-                        help="depth-1 pipelined round executor: round N+1 "
-                             "dispatches before round N's success flag "
+                        help="pipelined round executor: later rounds "
+                             "dispatch before round N's success flag "
                              "materializes (server.pipeline)")
+    parser.add_argument("--pipeline-depth", type=str, default=None,
+                        metavar="K",
+                        help="pipeline depth: K rounds in flight beyond "
+                             "the one being resolved (0 = no overlap, "
+                             "'auto' = tune from the ledger's measured "
+                             "host/device ratio for this config; "
+                             "server.pipeline-depth).  Implies --pipeline")
     parser.add_argument("--checkpoint-async", action="store_true",
                         help="background checkpoint writer: serialize + "
                              "write + fsync off the round loop "
@@ -248,6 +255,11 @@ def server_main(argv=None) -> None:
     perf_overrides = {}
     if args.pipeline:
         perf_overrides["pipeline"] = True
+    if args.pipeline_depth is not None:
+        # a depth without --pipeline implies the pipelined executor; the
+        # Config normalizes/validates the value ("auto" or 0..max)
+        perf_overrides["pipeline"] = True
+        perf_overrides["pipeline_depth"] = args.pipeline_depth
     if args.checkpoint_async:
         perf_overrides["checkpoint_async"] = True
     if args.resume:
@@ -383,14 +395,22 @@ def watch_main(argv=None) -> int:
             stalled = False
         # degraded ≠ stalled ≠ healthy: the pipelined executor demoted to
         # depth-0 after consecutive rollbacks — progressing, but flagged
+        depth = last.get("pipeline_depth")
+        depth_text = (f" (depth {depth}"
+                      + (f", configured {health['configured_depth']}"
+                         if isinstance(health.get("configured_depth"), int)
+                         else "") + ")") \
+            if isinstance(depth, int) else ""
         if health.get("status") == "degraded":
             if not degraded:
                 print_with_color(
-                    f"[watch] executor DEGRADED: {health}", "yellow")
+                    f"[watch] executor DEGRADED{depth_text}: {health}",
+                    "yellow")
             degraded = True
         elif degraded and code != 503:
-            print_with_color("[watch] executor re-promoted (healthy)",
-                             "cyan")
+            print_with_color(
+                f"[watch] executor re-promoted (healthy{depth_text})",
+                "cyan")
             degraded = False
         rnd = last.get("round")
         if last and rnd != seen_round:
@@ -410,6 +430,8 @@ def watch_main(argv=None) -> int:
             if gauges:
                 msg += ("  [" + " ".join(f"{k}={v:.4g}" for k, v in gauges)
                         + "]")
+            if isinstance(depth, int):
+                msg += f" depth={depth}"
             print(f"[watch] round {rnd} ok={last.get('ok')} "
                   f"{msg}".rstrip(), flush=True)
         if args.once:

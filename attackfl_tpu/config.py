@@ -41,6 +41,11 @@ AGGREGATION_MODES = (
 
 ATTACK_MODES = ("Random", "Min-Max", "Min-Sum", "Opt-Fang", "LIE")
 
+# Hard ceiling on the pipelined executor's in-flight round queue: beyond
+# this, each extra slot only adds device-state residency (one full state
+# pytree per slot when checkpointing) without host latency left to hide.
+MAX_PIPELINE_DEPTH = 32
+
 DATA_NAMES = ("ICU", "HAR", "CIFAR10")
 
 
@@ -296,12 +301,22 @@ class Config:
     # longer gates round acceptance — an opt-in semantic change (the
     # reference blocks every round on the gate, server.py:539-547).
     validation_async: bool = False
-    # Depth-1 software-pipelined round executor (Simulator.run): round N's
+    # Depth-k software-pipelined round executor (Simulator.run): round N's
     # success flag resolves on the host while round N+1's programs are
     # already dispatched; a failed round keeps the previous params through
     # the same accept-select the fused scan uses.  Off by default — the
     # synchronous path stays the parity reference.
     pipeline: bool = False
+    # Pipeline depth k: how many rounds may be in flight beyond the one
+    # being resolved (ISSUE 10).  1 = the historical depth-1 overlap;
+    # 0 = dispatch-then-resolve with no overlap (the demoted mode, useful
+    # for bench floors); "auto" = pick k from the ledger's measured
+    # host_resolution_latency / round_device_time ratio for this config's
+    # fingerprint, clamped by numerics_window and the checkpoint cadence
+    # (see Simulator.resolve_pipeline_depth).  Every depth runs the SAME
+    # single-round jitted program — params are bit-identical to the
+    # synchronous path at any k (tests/test_pipeline.py).
+    pipeline_depth: int | str = 1
     # Background checkpoint persistence (utils/checkpoint
     # AsyncCheckpointWriter): the device->host gather stays on the round
     # loop, serialization + file write + fsync move to a writer thread
@@ -452,6 +467,23 @@ class Config:
             raise ValueError(
                 f"checkpoint_keep must be >= 1 (manifest retention depth), "
                 f"got {self.checkpoint_keep}")
+        if isinstance(self.pipeline_depth, str):
+            depth_text = self.pipeline_depth.strip().lower()
+            if depth_text != "auto":
+                try:
+                    object.__setattr__(self, "pipeline_depth",
+                                       int(depth_text))
+                except ValueError:
+                    raise ValueError(
+                        f"pipeline_depth must be an integer or 'auto', got "
+                        f"{self.pipeline_depth!r}") from None
+            else:
+                object.__setattr__(self, "pipeline_depth", "auto")
+        if isinstance(self.pipeline_depth, int) and not (
+                0 <= self.pipeline_depth <= MAX_PIPELINE_DEPTH):
+            raise ValueError(
+                f"pipeline_depth must be in [0, {MAX_PIPELINE_DEPTH}] or "
+                f"'auto', got {self.pipeline_depth}")
         if self.pipeline_demote_after < 1 or self.pipeline_repromote_after < 1:
             raise ValueError(
                 "pipeline_demote_after and pipeline_repromote_after must be "
@@ -630,6 +662,8 @@ def config_from_dict(raw: dict) -> Config:
         validation_async=bool(_get(server, "validation-async",
                                    defaults.validation_async)),
         pipeline=bool(_get(server, "pipeline", defaults.pipeline)),
+        pipeline_depth=_get(server, "pipeline-depth",
+                            defaults.pipeline_depth),
         checkpoint_async=bool(_get(server, "checkpoint-async",
                                    defaults.checkpoint_async)),
         resume=bool(_get(server, "resume", defaults.resume)),

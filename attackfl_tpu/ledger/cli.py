@@ -45,8 +45,21 @@ def _fmt(value: Any, nd: int = 4) -> str:
     return "-" if value is None else str(value)
 
 
+def _fmt_depth(entry: dict[str, Any]) -> str:
+    """`ledger list` depth column: configured depth, with the effective
+    depth appended when a demotion dropped it mid-run (``4>0``)."""
+    depth = entry.get("pipeline_depth")
+    if not isinstance(depth, int) or isinstance(depth, bool):
+        return "-"
+    effective = entry.get("pipeline_depth_effective")
+    if isinstance(effective, int) and not isinstance(effective, bool) \
+            and effective != depth:
+        return f"{depth}>{effective}"
+    return str(depth)
+
+
 def format_list(entries: list[dict[str, Any]]) -> str:
-    lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'src':<7}"
+    lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'depth':<7}{'src':<7}"
              f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"]
     for entry in entries:
         workload = "-"
@@ -65,6 +78,7 @@ def format_list(entries: list[dict[str, Any]]) -> str:
             f"{str(entry.get('record_id') or '?')[:21]:<22}"
             f"{_fmt_ts(entry.get('ts')):<18}"
             f"{str(entry.get('executor') or '-'):<11}"
+            f"{_fmt_depth(entry):<7}"
             f"{str(entry.get('source') or '-'):<7}"
             f"{workload[:27]:<28}"
             f"{rounds_text:>7}"
@@ -75,6 +89,10 @@ def format_list(entries: list[dict[str, Any]]) -> str:
 def format_record(record: dict[str, Any]) -> str:
     lines = [f"record {record.get('record_id')} "
              f"[{record.get('source')}/{record.get('executor')}"
+             + (f"/depth={_fmt_depth(record)}"
+                if isinstance(record.get("pipeline_depth"), int)
+                and not isinstance(record.get("pipeline_depth"), bool)
+                else "")
              + ("/resumed" if record.get("resumed") else "") + "]"]
     lines.append(
         f"  run_id={record.get('run_id') or '-'} "
@@ -146,6 +164,11 @@ def format_compare(diff: dict[str, Any]) -> str:
     if executor.get("old") != executor.get("new"):
         lines.append(f"  executor: {executor.get('old')} -> "
                      f"{executor.get('new')}")
+    depth = diff.get("pipeline_depth") or {}
+    if depth.get("old") != depth.get("new"):
+        lines.append(f"  pipeline depth: {depth.get('old')} -> "
+                     f"{depth.get('new')}  [different depths are "
+                     "non-peers for rolling baselines]")
 
     def render(title: str, columns: dict[str, Any], pct: bool = True):
         rows = []

@@ -155,6 +155,8 @@ def compare_records(old: dict[str, Any],
         "fingerprint_match": (old.get("fingerprint") == new.get("fingerprint")
                               and bool(old.get("fingerprint"))),
         "executor": {"old": old.get("executor"), "new": new.get("executor")},
+        "pipeline_depth": {"old": old.get("pipeline_depth"),
+                           "new": new.get("pipeline_depth")},
         "perf": perf,
         "time_attribution": attribution,
         "phases": phases,
@@ -178,13 +180,21 @@ def rolling_baseline(records: list[dict[str, Any]],
     — e.g. records imported without full configs), so baseline peers
     must ALSO agree on the (attack × defense × seed) cell identity.
     Non-matrix records carry no ``cell`` and match each other as before
-    (None == None)."""
+    (None == None).
+
+    The ``pipeline_depth`` key (ISSUE 10, same lesson): the depth knob
+    is fingerprint-VOLATILE — params are bit-identical at every depth —
+    but throughput is exactly what depth changes, so records at
+    different depths are non-peers for the rolling baseline (a depth-4
+    run must not be gated against depth-0 history).  Non-pipelined
+    records carry None and keep matching each other."""
     fingerprint = candidate.get("fingerprint")
     peers = [r for r in records
              if r is not candidate
              and r.get("fingerprint") == fingerprint
              and r.get("executor") == candidate.get("executor")
              and r.get("cell") == candidate.get("cell")
+             and r.get("pipeline_depth") == candidate.get("pipeline_depth")
              and (candidate.get("record_id") is None
                   or r.get("record_id") != candidate.get("record_id"))]
     if not peers or not fingerprint:
@@ -209,6 +219,7 @@ def rolling_baseline(records: list[dict[str, Any]],
         "fingerprint": fingerprint,
         "executor": candidate.get("executor"),
         "cell": candidate.get("cell"),
+        "pipeline_depth": candidate.get("pipeline_depth"),
         "baseline_of": [r.get("record_id") for r in peers],
     }
     for key, _ in PERF_COLUMNS:

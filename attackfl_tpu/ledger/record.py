@@ -254,6 +254,19 @@ def derive_record(events: list[dict[str, Any]],
                          ("tpr", "fpr", "precision", "rounds",
                           "attack_rounds", "rollbacks")}
 
+    # depth-k executor provenance (ISSUE 10): the resolved depth from the
+    # run header (schema v8) plus the run's MINIMUM effective depth — 0
+    # when the demote state machine fired at any point, else the resolved
+    # k.  Both None on non-pipelined runs.  `ledger regress` treats
+    # records at different depths as non-peers (compare.rolling_baseline)
+    # — the same lesson as the matrix `cell` key.
+    depth = header.get("pipeline_depth")
+    if isinstance(depth, bool) or not isinstance(depth, int):
+        depth = None
+    demoted = any(e.get("kind") == "degrade"
+                  and e.get("state") == "demoted" for e in events)
+    configured = header.get("pipeline_depth_configured")
+
     steady = rates.get("rounds_per_sec_steady")
     record: dict[str, Any] = {
         "ledger_schema": LEDGER_SCHEMA_VERSION,
@@ -262,6 +275,11 @@ def derive_record(events: list[dict[str, Any]],
         "run_id": summary.get("run_id") or next(
             (e.get("run_id") for e in events if e.get("run_id")), None),
         "executor": executor,
+        "pipeline_depth": depth,
+        "pipeline_depth_configured": (str(configured)
+                                      if configured is not None else None),
+        "pipeline_depth_effective": ((0 if demoted else depth)
+                                     if depth is not None else None),
         "resumed": summary.get("resumed_from") is not None,
         "fingerprint": fingerprint,
         "git_rev": str(header.get("git_rev") or ""),
@@ -417,6 +435,27 @@ def records_from_bench(parsed: dict[str, Any]) -> list[dict[str, Any]]:
                             "compile_once_saving_s"):
                     if key in detail:
                         record[key] = detail[key]
+                records.append(record)
+    elif metric.startswith("fl_depth_sweep"):
+        # depth sweep (ISSUE 10): one record per measured depth so every
+        # k gets its own baseline trajectory — `pipeline_depth` rides the
+        # record, making depths non-peers for `ledger regress` exactly
+        # like engine-run records
+        by_depth = detail.get("by_depth")
+        if isinstance(by_depth, dict):
+            def depth_key(name: str) -> int:
+                return int(name) if str(name).lstrip("-").isdigit() else -1
+
+            for key in sorted(by_depth, key=depth_key):
+                block = by_depth[key]
+                if not isinstance(block, dict):
+                    continue
+                record = rate_record(f"depth{key}", "pipelined", block)
+                if depth_key(key) >= 0:
+                    record["pipeline_depth"] = depth_key(key)
+                    record["pipeline_depth_effective"] = depth_key(key)
+                if isinstance(detail.get("auto_pick"), dict):
+                    record["auto_pick"] = detail["auto_pick"]
                 records.append(record)
     elif metric.startswith("fl_compile_cache"):
         for variant in ("first_run", "warm_cache"):

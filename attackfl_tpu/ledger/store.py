@@ -46,11 +46,13 @@ LOCK_NAME = "ledger.lock"
 INDEX_VERSION = 1
 
 # The per-record summary the index carries (and `ledger list` renders).
-# `sweep_id`/`cell` (ISSUE 9) are None on non-matrix records — the index
-# self-heals from the JSONL, so pre-v9 indexes simply rebuild with them.
+# `sweep_id`/`cell` (ISSUE 9) are None on non-matrix records, as are the
+# `pipeline_depth*` fields (ISSUE 10) on non-pipelined ones — the index
+# self-heals from the JSONL, so older indexes simply rebuild with them.
 INDEX_FIELDS = ("record_id", "ts", "run_id", "fingerprint", "executor",
                 "source", "mode", "model", "total_clients", "rounds",
-                "ok_rounds", "rounds_per_sec_steady", "sweep_id", "cell")
+                "ok_rounds", "rounds_per_sec_steady", "sweep_id", "cell",
+                "pipeline_depth", "pipeline_depth_effective")
 
 
 def resolve_ledger_dir(explicit: str | None = None,

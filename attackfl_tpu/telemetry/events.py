@@ -85,6 +85,16 @@ Schema v7 (ISSUE 9) extends v6 — every v1-v6 file still validates:
   child run carries both, so cell artifacts join their sweep.
   Type-checked when present; v1-v6 headers carry none of them.
 
+Schema v8 (ISSUE 10) extends v7 — every v1-v7 file still validates:
+
+* ``run_header`` MAY carry ``pipeline_depth`` (the depth-k executor's
+  RESOLVED depth for this run) and ``pipeline_depth_configured`` (the
+  configured value as text — ``"auto"`` included, so the ledger can tell
+  a tuned pick from an explicit one).  Type-checked when present; v1-v7
+  headers carry neither.  No new kinds: effective-depth transitions ride
+  the existing ``degrade`` events (which now carry a ``depth`` field —
+  extra fields were always allowed).
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -101,7 +111,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -165,14 +175,17 @@ _OPTIONAL_METRIC_FIELDS: dict[str, Any] = {
     "round": int, "broadcast": int, "numerics": dict, "hist": list,
 }
 
-# --- schema v5/v6/v7: optional provenance fields on `run_header` events
-# (type-checked when present; v1-v4 headers carry none of these;
+# --- schema v5/v6/v7/v8: optional provenance fields on `run_header`
+# events (type-checked when present; v1-v4 headers carry none of these;
 # monitor_port — the ACTUAL bound port under `monitor-port: 0` — is v6;
-# sweep_id/cell — matrix-sweep membership — are v7)
+# sweep_id/cell — matrix-sweep membership — are v7; pipeline_depth /
+# pipeline_depth_configured — the depth-k executor's resolved and
+# configured depth — are v8)
 _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     "git_rev": str, "jaxlib_version": str, "platform": str,
     "monitor_port": int,
     "sweep_id": str, "cell": str,
+    "pipeline_depth": int, "pipeline_depth_configured": str,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -191,6 +204,9 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     5: frozenset({"ledger"}),  # + optional run_header provenance fields
     6: frozenset({"job", "service"}),  # + optional run_header monitor_port
     7: frozenset({"matrix"}),  # + optional run_header sweep_id/cell
+    # v8 adds no kinds — only the optional run_header pipeline-depth
+    # fields (ISSUE 10), like v3's optional metric payload
+    8: frozenset(),
 }
 
 

@@ -186,6 +186,10 @@ class RunMonitor:
         # distinct from both healthy (200 ok) and stalled (503): the run
         # IS making progress, just without pipelining
         self._degraded: dict[str, Any] | None = None
+        # current effective pipeline depth (ISSUE 10): the configured k
+        # at run start, 0 while demoted, back to k on re-promotion; None
+        # on non-pipelined executors (gauge absent rather than 0)
+        self._pipeline_depth: int | None = None
         # cross-run ledger (ISSUE 7): /runs lists the store's index so a
         # live monitor also answers "how does this run compare to the
         # last ones" — set by the engine when the ledger is enabled
@@ -265,6 +269,13 @@ class RunMonitor:
         evidence — round, consecutive failures; None = re-promoted)."""
         with self._lock:
             self._degraded = dict(info) if info else None
+
+    def set_pipeline_depth(self, depth: int | None) -> None:
+        """Record the pipelined executor's current EFFECTIVE depth (the
+        ``attackfl_pipeline_depth`` gauge: configured k while healthy, 0
+        while demoted — demote/re-promote transitions call this)."""
+        with self._lock:
+            self._pipeline_depth = None if depth is None else int(depth)
 
     def set_ledger(self, store) -> None:
         """Attach the cross-run ledger store backing ``/runs`` (the store
@@ -377,6 +388,8 @@ class RunMonitor:
             out = dict(self._last_round or {})
             if self._last_numerics:
                 out["numerics"] = dict(self._last_numerics)
+            if self._pipeline_depth is not None:
+                out["pipeline_depth"] = self._pipeline_depth
             return out
 
     def metrics_text(self) -> str:
@@ -389,6 +402,7 @@ class RunMonitor:
             rounds = self._rounds_completed
             stalled = int(self._stalled)
             degraded = int(self._degraded is not None)
+            pipeline_depth = self._pipeline_depth
         lines = [
             "# TYPE attackfl_rounds_completed counter",
             f"attackfl_rounds_completed {rounds}",
@@ -400,6 +414,11 @@ class RunMonitor:
             f"attackfl_stall_threshold_seconds "
             f"{self.stall_threshold_seconds():.6f}",
         ]
+        if pipeline_depth is not None:
+            lines += [
+                "# TYPE attackfl_pipeline_depth gauge",
+                f"attackfl_pipeline_depth {pipeline_depth}",
+            ]
         if durations:
             lines += [
                 "# TYPE attackfl_round_seconds_median gauge",

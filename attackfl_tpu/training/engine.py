@@ -17,10 +17,13 @@ reference (which retries forever), retries are capped.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
+import statistics
 import time
 import uuid
 import warnings
+from collections import deque
 from typing import Any, Callable
 
 # Donation here is for EARLY FREE (the runtime may release a donated
@@ -68,6 +71,65 @@ MAX_ROUND_RETRIES = 20
 # (compile time scales with scan length; 16 bounds the first-dispatch
 # compile while amortizing per-dispatch overhead over 16 rounds)
 DEFAULT_SCAN_CHUNK = 16
+# `pipeline_depth: auto` ceiling (ISSUE 10): past ~8 in-flight rounds
+# each extra slot only adds device-state residency without host
+# resolution latency left to hide on any measured workload.
+AUTO_DEPTH_CAP = 8
+
+
+def auto_depth_from_records(records, fingerprint: str, window: int = 5
+                            ) -> tuple[int | None, dict[str, Any]]:
+    """Measured auto-tune inputs -> proposed pipeline depth (pre-clamp).
+
+    The cross-run ledger (ISSUE 7) records the inputs on every run:
+    ``round_device_time`` (D — device seconds per round) and
+    ``host_resolution_latency`` (H — host seconds per round spent
+    resolving verdicts), plus the per-round FOREGROUND checkpoint
+    seconds from ``time_attribution`` (synchronous per-round
+    checkpointing blocks the resolve path — exactly the host latency a
+    deeper queue hides; the async writer's ``checkpoint_overlapped_s``
+    is already hidden and excluded).  The pending queue needs enough
+    in-flight rounds to cover that host work with device compute, so the
+    pick is ``k = ceil((H + ckpt_fg) / D)`` (floored at 1).  Medians
+    over the newest ``window`` fingerprint-matching records keep one
+    noisy run from steering the pick — ``pipeline_depth`` is
+    fingerprint-VOLATILE (utils/fingerprint), so runs at any depth feed
+    the same pool.  Returns ``(k, info)``; ``(None, info)`` when no
+    matching record carries the inputs."""
+    peers: list[tuple[float, float]] = []
+
+    # plain JSON numbers out of ledger records — no device values here,
+    # so no float(...) materialization (the host-sync rule's territory)
+    def number(value) -> float | None:
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            return value + 0.0
+        return None
+
+    for record in records:
+        if record.get("fingerprint") != fingerprint:
+            continue
+        device = number(record.get("round_device_time"))
+        host = number(record.get("host_resolution_latency"))
+        if device is None or device <= 0 or host is None or host < 0:
+            continue
+        rounds = number(record.get("rounds"))
+        ckpt_fg = number(
+            (record.get("time_attribution") or {}).get("checkpoint_s"))
+        if ckpt_fg is not None and rounds and rounds > 0:
+            host += ckpt_fg / rounds
+        peers.append((device, host))
+    if not peers:
+        return None, {"reason": "no_ledger_peers"}
+    peers = peers[-window:]
+    device = statistics.median([d for d, _ in peers])
+    host = statistics.median([h for _, h in peers])
+    ratio = host / device
+    return max(1, math.ceil(ratio)), {
+        "round_device_time": round(device, 6),
+        "host_latency_per_round": round(host, 6),
+        "ratio": round(ratio, 4),
+        "peers": len(peers),
+    }
 
 
 def sample_inputs(data_name: str):
@@ -421,8 +483,16 @@ class Simulator:
         # fused chunk programs, keyed by (scan length, donate)
         self._fused_cache: dict[tuple, Callable] = {}
         # pipelined single-round programs, keyed by (include_eval, donate)
+        # — ONE program serves every pipeline depth (the depth is pure
+        # host-side queue discipline), so depth changes never retrace
         self._pipeline_cache: dict[tuple, Callable] = {}
         self._pipeline_exe_cache: dict[tuple, Any] = {}
+        # resolved pipeline depth (ISSUE 10): set by
+        # resolve_pipeline_depth before the run header goes out so the
+        # header (and through it the ledger record) carries both the
+        # configured value ("auto" included) and the concrete k
+        self._depth_resolved: int | None = None
+        self._depth_info: dict[str, Any] | None = None
         # reload_parameters_per_round: (mtime_ns, size) -> cached params so
         # an unchanged checkpoint file costs a stat, not a deserialize
         self._reload_cache: tuple[tuple[int, int], Any] | None = None
@@ -807,6 +877,13 @@ class Simulator:
             **({"monitor_port": int(self.monitor.port)}
                if self.monitor is not None and self.monitor.port is not None
                else {}),
+            # schema v8: the pipelined executor's resolved depth + the
+            # configured value ("auto" included) — resolved BEFORE the
+            # header goes out (run() orders it so), absent on
+            # non-pipelined runs
+            **({"pipeline_depth": int(self._depth_resolved),
+                "pipeline_depth_configured": str(self.cfg.pipeline_depth)}
+               if self._depth_resolved is not None else {}),
             # schema v7: sweep_id/cell when this run is a matrix cell
             **self.header_extra,
         )
@@ -1921,6 +1998,82 @@ class Simulator:
     # pipelined per-round path
     # ------------------------------------------------------------------
 
+    def resolve_pipeline_depth(self, save_checkpoints: bool = True) -> int:
+        """Resolve ``cfg.pipeline_depth`` to a concrete k for this run.
+
+        An explicit integer is used as-is (range-checked by the config).
+        ``"auto"`` reads the cross-run ledger's measured
+        ``round_device_time`` / ``host_resolution_latency`` for this
+        config's fingerprint (:func:`auto_depth_from_records` — the depth
+        knob itself is fingerprint-volatile, so runs at any depth feed
+        the same measurement pool) and picks ``k = ceil(H/D)``, clamped
+        by:
+
+        * :data:`AUTO_DEPTH_CAP` — past it each in-flight slot only adds
+          device-state residency;
+        * ``telemetry.numerics_window`` when in-graph numerics is on —
+          numerics rows resolve k rounds late, and the reporting window
+          the drainer guarantees is sized to the ring;
+        * the checkpoint cadence: per-round SYNCHRONOUS checkpointing
+          (``save_checkpoints`` without the async writer) serializes a
+          state gather + write + fsync into every resolve, so auto never
+          picks past 2 there — deeper queues just pile behind the fsync.
+
+        No ledger measurement yet -> depth 1 (today's behavior), loudly.
+        The result and its derivation are stashed for the run header
+        (``pipeline_depth`` / ``pipeline_depth_configured``, schema v8).
+        """
+        configured = self.cfg.pipeline_depth
+        if isinstance(configured, int):
+            self._depth_resolved = configured
+            self._depth_info = {"source": "config", "depth": configured}
+            return configured
+        info: dict[str, Any] = {"source": "auto"}
+        k: int | None = None
+        try:
+            if self._ledger is not None:
+                records, _ = self._ledger.load()
+            else:
+                from attackfl_tpu.ledger.store import (
+                    LedgerStore, resolve_ledger_dir,
+                )
+
+                directory = resolve_ledger_dir(
+                    self.cfg.telemetry.ledger_dir or None,
+                    base=getattr(self.telemetry, "base_dir", None))
+                # never CREATE a ledger dir just to discover it is empty
+                records = (LedgerStore(directory).load()[0]
+                           if os.path.isdir(directory) else [])
+            k, measured = auto_depth_from_records(
+                records, self._ckpt_manager.fingerprint)
+            info.update(measured)
+        except Exception as e:  # noqa: BLE001 — auto must never fail the run
+            info["error"] = f"{type(e).__name__}: {e}"[:200]
+        if k is None:
+            k = 1
+            print_with_color(
+                "[pipeline] depth auto: no ledger measurement for this "
+                "config yet — defaulting to depth-1 (a run with "
+                "telemetry.ledger on feeds the auto-tuner)", "yellow")
+        cap = AUTO_DEPTH_CAP
+        if self._numerics_on:
+            cap = min(cap, self.cfg.telemetry.numerics_window)
+        if save_checkpoints and not self.cfg.checkpoint_async:
+            cap = min(cap, 2)
+        if k > cap:
+            info["clamped_from"] = k
+            k = cap
+        info["depth"] = k
+        self._depth_resolved = k
+        self._depth_info = info
+        if "ratio" in info:
+            print_with_color(
+                f"[pipeline] depth auto -> {k} (measured host/device ratio "
+                f"{info['ratio']} over {info['peers']} ledger record(s)"
+                + (f", clamped from {info['clamped_from']}"
+                   if "clamped_from" in info else "") + ")", "cyan")
+        return k
+
     def _pipeline_step_fn(self, include_eval: bool, donate: bool) -> Callable:
         """One round as ONE jitted program (the fused scan body, unrolled
         to a single step).  ``donate`` recycles the input state's buffers
@@ -2002,41 +2155,54 @@ class Simulator:
         save_checkpoints: bool,
         verbose: bool,
         stop: Callable[[int], bool] | None = None,
+        depth: int | None = None,
     ) -> tuple[dict[str, Any], list[dict[str, Any]]]:
-        """Depth-1 software-pipelined round loop (``cfg.pipeline``).
+        """Depth-k software-pipelined round loop (``cfg.pipeline`` +
+        ``cfg.pipeline_depth`` — ISSUE 10 generalizes the depth-1 loop).
 
-        Round N's programs (train -> attack -> aggregate -> validate ->
-        accept) are dispatched as ONE jitted step whose acceptance is the
-        fused body's device-side ``where`` select — so the state round N+1
-        trains against is correct whether or not round N succeeded, and
-        the host can resolve round N's success flag one step later, while
-        round N+1 is already computing.  The rollback path IS the select:
-        a failed round keeps the previous params and advances the rng,
-        broadcast clock and genuine-leak cache exactly like the
-        synchronous retry path (parity-tested in tests/test_pipeline.py).
+        Every round is dispatched as the SAME single jitted step program
+        (train -> attack -> aggregate -> validate -> device-side
+        accept-select), and up to ``depth`` rounds stay in flight beyond
+        the oldest unresolved one: the host resolves success flags up to
+        k rounds late, in dispatch order, while the device keeps
+        computing.  Because acceptance IS the in-program ``where``
+        select, a rollback at any queue slot needs no host intervention —
+        the rounds dispatched after it already trained against the
+        rolled-back (last accepted) state, exactly like the synchronous
+        retry path — so the queue never has to be flushed and params stay
+        bit-identical to sync at every depth (tests/test_pipeline.py).
+        ``depth`` 0 = dispatch-then-resolve with no overlap (the demoted
+        mode); None resolves it from the config (``"auto"`` reads the
+        ledger — :meth:`resolve_pipeline_depth`).
 
         With checkpointing off the step DONATES the state pytree (do not
         reuse a passed-in ``state`` afterwards — same contract as
-        run_fast); with checkpointing on the resolved round's state is
-        gathered on this thread and handed to the async writer (or written
-        synchronously without ``cfg.checkpoint_async``).
+        run_fast); with checkpointing on every queue slot pins its
+        round's state until resolution, and the resolved round's state is
+        handed to the async writer (or written synchronously without
+        ``cfg.checkpoint_async``).
 
         **Graceful degradation** (ISSUE 6): after
-        ``cfg.pipeline_demote_after`` consecutive device-side rollbacks
-        the executor DEMOTES to depth-0 — the same jitted step program,
-        but each round is resolved before the next one dispatches, so a
-        failure storm stops paying for wasted in-flight rounds and the
-        host sees every verdict immediately.  After
+        ``cfg.pipeline_demote_after`` consecutive device-side rollbacks —
+        e.g. a NaN storm filling ALL k in-flight slots — the executor
+        DEMOTES to depth-0: no new dispatches until the queue drains,
+        then each round resolves before the next dispatches, so a failure
+        storm stops paying for wasted in-flight rounds and the host sees
+        every verdict immediately.  After
         ``cfg.pipeline_repromote_after`` consecutive clean rounds it
-        re-promotes to depth-1.  Both transitions emit ``degrade`` events
-        and flip the live monitor's degraded flag (/healthz
-        ``status: degraded`` — distinct from both healthy and stalled).
-        Because demotion only changes WHEN the host resolves (never what
-        the device computes), final params stay bit-identical to the
-        never-demoted and fully-synchronous runs.
+        re-promotes to the CONFIGURED depth, not 1.  Both transitions
+        emit ``degrade`` events (carrying the depth they leave the
+        executor at), flip the live monitor's degraded flag (/healthz
+        ``status: degraded``) and its ``attackfl_pipeline_depth`` gauge —
+        and never retrace: every depth, demoted included, dispatches the
+        one cached step program.  Because demotion only changes WHEN the
+        host resolves (never what the device computes), final params stay
+        bit-identical to the never-demoted and fully-synchronous runs.
         """
         cfg = self.cfg
         tel = self.telemetry
+        if depth is None:
+            depth = self.resolve_pipeline_depth(save_checkpoints)
         history: list[dict[str, Any]] = []
         t_start = time.perf_counter()
         self._start_monitor()
@@ -2049,28 +2215,34 @@ class Simulator:
         # donation safety latch in __init__)
         donate = not save_checkpoints and self._state_donation_ok
         step = self._pipeline_step_fn(include_eval, donate)
-        pending: dict[str, Any] | None = None
+        # FIFO of unresolved rounds, dispatch order; holds at most
+        # overlap()+1 slots (the one about to resolve + the in-flight k)
+        queue: deque[dict[str, Any]] = deque()
         consecutive_failures = 0
         degraded = False
         clean_streak = 0
         last_resolve = time.perf_counter()
+        if self.monitor is not None:
+            self.monitor.set_pipeline_depth(depth)
+
+        def overlap() -> int:
+            """Rounds allowed in flight beyond the resolving one: the
+            configured depth, or 0 while demoted."""
+            return 0 if degraded else depth
 
         try:
-            while completed < num_rounds or pending is not None:
+            while completed < num_rounds or queue:
                 # graceful-drain seam: once the hook says stop, dispatch
                 # no new rounds; in-flight ones still resolve (and
                 # checkpoint) below, then the loop exits quiesced
                 stopping = stop is not None and stop(completed)
-                if stopping and pending is None:
+                if stopping and not queue:
                     break
-                new_pending: dict[str, Any] | None = None
-                want_more = (completed + (1 if pending is not None else 0)
-                             < num_rounds) and not stopping
-                # demoted: no overlap — never dispatch past an unresolved
-                # round (depth-0); healthy: depth-1 dispatch-then-resolve
-                if want_more and (pending is None or not degraded):
+                want_more = (completed + len(queue) < num_rounds
+                             and not stopping)
+                if want_more and len(queue) <= overlap():
                     broadcast += 1
-                    target_round = completed + (2 if pending is not None else 1)
+                    target_round = completed + len(queue) + 1
                     self._maybe_start_profile(target_round)
                     with tel.tracer.span("dispatch", round=target_round,
                                          broadcast=broadcast):
@@ -2091,19 +2263,21 @@ class Simulator:
                         else:
                             val = self.validation.test_async(
                                 new_state["global_params"])
-                    new_pending = {
+                    queue.append({
                         "metrics": metrics,
                         "broadcast": broadcast,
                         "val": val,
-                        # kept ONLY for checkpointing; with donation on, round
-                        # N+1's dispatch consumes these buffers
+                        # kept ONLY for checkpointing; with donation on,
+                        # the next dispatch consumes these buffers
                         "state": new_state if save_checkpoints else None,
-                    }
+                    })
                     state = new_state
-                if degraded and pending is None and new_pending is not None:
-                    # depth-0: resolve the just-dispatched round immediately
-                    pending, new_pending = new_pending, None
-                if pending is not None:
+                    want_more = (completed + len(queue) < num_rounds
+                                 and not stopping)
+                # resolve the oldest slot once the queue is past its
+                # overlap budget, or while draining (stop hook / tail)
+                if queue and (len(queue) > overlap() or not want_more):
+                    pending = queue.popleft()
                     round_no = completed + 1
                     with tel.tracer.span("resolve", round=round_no):
                         entry = self._resolve_pipeline_round(pending, round_no)
@@ -2130,13 +2304,15 @@ class Simulator:
                                 tel.counters.inc("executor_repromotions")
                                 tel.events.emit(
                                     "degrade", state="repromoted",
-                                    round=round_no,
+                                    round=round_no, depth=depth,
                                     clean_rounds=cfg.pipeline_repromote_after)
                                 if self.monitor is not None:
                                     self.monitor.set_degraded(None)
+                                    self.monitor.set_pipeline_depth(depth)
                                 print_with_color(
-                                    f"[pipeline] re-promoted to depth-1 "
-                                    f"after {cfg.pipeline_repromote_after} "
+                                    f"[pipeline] re-promoted to "
+                                    f"depth-{depth} after "
+                                    f"{cfg.pipeline_repromote_after} "
                                     "clean rounds", "cyan")
                         if verbose:
                             keys = [k for k in ("roc_auc", "accuracy", "nll",
@@ -2164,15 +2340,20 @@ class Simulator:
                             info = {
                                 "round": round_no,
                                 "consecutive_failures": consecutive_failures,
+                                "depth": 0,
+                                "configured_depth": depth,
+                                "in_flight": len(queue),
                             }
                             tel.counters.inc("executor_demotions")
                             tel.events.emit("degrade", state="demoted", **info)
                             if self.monitor is not None:
                                 self.monitor.set_degraded(info)
+                                self.monitor.set_pipeline_depth(0)
                             print_with_color(
                                 f"[pipeline] {consecutive_failures} "
-                                "consecutive rollbacks — demoting to "
-                                "synchronous (depth-0) resolution", "yellow")
+                                "consecutive rollbacks — demoting from "
+                                f"depth-{depth} to synchronous (depth-0) "
+                                "resolution", "yellow")
                         if consecutive_failures > MAX_ROUND_RETRIES:
                             raise RuntimeError(
                                 f"Round {round_no} failed "
@@ -2180,7 +2361,6 @@ class Simulator:
                                 "reference would retry forever, "
                                 "server.py:546-556)")
                     self._maybe_stop_profile(completed)
-                pending = new_pending
         finally:
             if self.monitor is not None and degraded:
                 self.monitor.set_degraded(None)
@@ -2207,9 +2387,10 @@ class Simulator:
         server.py:559-567).
 
         ``pipeline`` (default: ``cfg.pipeline``) routes through the
-        depth-1 software-pipelined executor (:meth:`_run_pipelined`) —
+        depth-k software-pipelined executor (:meth:`_run_pipelined`,
+        k = ``cfg.pipeline_depth``, ``"auto"`` tuned from the ledger) —
         same final params and per-round ``ok`` sequence as the synchronous
-        path, with round N+1 dispatched before round N's flag is
+        path, with up to k rounds dispatched before round N's flag is
         materialized.  Host-side-defense modes (gmm / fltracer,
         hyper-detection, reload-per-round) fall back to the synchronous
         loop with a warning.
@@ -2226,13 +2407,18 @@ class Simulator:
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
         state = self._ensure_numerics_state(
             state if state is not None else self.load_or_init_state())
-        self._emit_run_header()
         use_pipeline = cfg.pipeline if pipeline is None else pipeline
+        depth = None
+        if use_pipeline and self.supports_fused():
+            # resolved BEFORE the run header goes out, so the header (and
+            # the ledger record derived from it) carries the concrete k
+            depth = self.resolve_pipeline_depth(save_checkpoints)
+        self._emit_run_header()
         if use_pipeline:
             if self.supports_fused():
                 return self._run_pipelined(num_rounds, state,
                                            save_checkpoints, verbose,
-                                           stop=stop)
+                                           stop=stop, depth=depth)
             print_with_color(
                 f"[pipeline] mode '{cfg.mode}' needs host-side per-round "
                 "work; falling back to the synchronous path.", "yellow")

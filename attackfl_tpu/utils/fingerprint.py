@@ -26,7 +26,8 @@ from typing import Any
 FINGERPRINT_VOLATILE = frozenset({
     "log_path", "checkpoint_dir", "compile_cache_dir", "telemetry",
     "num_round", "load_parameters", "resume", "faults", "checkpoint_async",
-    "checkpoint_keep", "pipeline", "pipeline_demote_after",
+    "checkpoint_keep", "pipeline", "pipeline_depth",
+    "pipeline_demote_after",
     "pipeline_repromote_after", "validation_every", "validation_async",
     "reload_parameters_per_round", "service",
 })

@@ -16,8 +16,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite is compile-bound (tiny shapes,
 # many distinct programs), so repeat runs drop from minutes to seconds.
+# Threshold 0 caches the MANY small programs too (aggregate / numerics /
+# evaluator jits recompiled by almost every test) — the same
+# cache-everything policy engine.enable_compile_cache applies to runs;
+# it bought the depth-k PR the headroom to keep tier-1 inside its budget.
 jax.config.update("jax_compilation_cache_dir", "/tmp/attackfl_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

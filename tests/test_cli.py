@@ -124,6 +124,41 @@ def test_server_client_end_to_end(config_path, capsys):
     assert not [f for f in os.listdir(reg_dir) if f.endswith(".json")]
 
 
+def test_pipeline_depth_flag_and_yaml(config_path, monkeypatch):
+    """--pipeline-depth K implies --pipeline and lands on the config
+    ('auto' included); the `server: pipeline-depth:` YAML key parses."""
+    import attackfl_tpu.training.engine as engine_mod
+
+    captured = {}
+
+    class FakeSim:
+        def __init__(self, cfg, use_mesh=False):
+            captured["cfg"] = cfg
+            self.telemetry = type("T", (), {"enabled": False})()
+
+        def run(self, num_rounds=None):
+            return {}, []
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(engine_mod, "Simulator", FakeSim)
+    cli.server_main(["--config", config_path, "--no-wait",
+                     "--pipeline-depth", "4"])
+    assert captured["cfg"].pipeline is True
+    assert captured["cfg"].pipeline_depth == 4
+    cli.server_main(["--config", config_path, "--no-wait",
+                     "--pipeline-depth", "auto"])
+    assert captured["cfg"].pipeline_depth == "auto"
+
+    from attackfl_tpu.config import config_from_dict
+    cfg = config_from_dict({"server": {"pipeline": True,
+                                       "pipeline-depth": 8}})
+    assert cfg.pipeline_depth == 8
+    assert config_from_dict(
+        {"server": {"pipeline-depth": "auto"}}).pipeline_depth == "auto"
+
+
 def test_server_main_coordinator_requires_no_wait(config_path, capsys):
     with pytest.raises(SystemExit):
         cli.server_main(["--config", config_path,

@@ -408,18 +408,44 @@ def test_pipeline_demotes_and_repromotes(tmp_path, monkeypatch):
     assert any(h.get("degraded") for h in hist)
 
 
-def test_degraded_run_params_bit_identical(tmp_path):
+def test_degraded_run_params_bit_identical(tmp_path, monkeypatch):
     """Demotion only changes WHEN the host resolves — final params match
-    the synchronous executor under the identical fault plan."""
+    the synchronous executor under the identical fault plan, both for the
+    historical depth-1 executor and (ISSUE 10) for a depth-3 queue whose
+    ALL k in-flight slots the storm rolls back on device: the demote
+    state machine fires (the escape valve) and re-promotion returns to
+    the CONFIGURED depth.  ONE sync reference serves both depths."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path / "tel"))
+    (tmp_path / "tel").mkdir()
     tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
     plan = parse_fault_plan("nan_storm@2;nan_storm@3;nan_storm@4")
+    cfg_sync = _cfg(tmp_path / "sync", num_round=4, faults=plan, **tel)
+    s_sync, hist_s = Simulator(cfg_sync).run(save_checkpoints=False,
+                                             verbose=False)
+
     cfg_pipe = _cfg(tmp_path / "pipe", num_round=4, pipeline=True,
                     pipeline_demote_after=2, pipeline_repromote_after=2,
                     faults=plan, **tel)
     s_pipe, _ = Simulator(cfg_pipe).run(save_checkpoints=False, verbose=False)
-    cfg_sync = _cfg(tmp_path / "sync", num_round=4, faults=plan, **tel)
-    s_sync, _ = Simulator(cfg_sync).run(save_checkpoints=False, verbose=False)
     assert _leaves_equal({"p": s_pipe["global_params"]},
+                         {"p": s_sync["global_params"]})
+
+    # depth-3 queue, storm filling all 3 in-flight slots (telemetry ON so
+    # the degrade evidence is on record)
+    cfg_k = _cfg(tmp_path / "pipe3", num_round=4, pipeline=True,
+                 pipeline_depth=3, pipeline_demote_after=3,
+                 pipeline_repromote_after=2, faults=plan)
+    sim = Simulator(cfg_k)
+    s_k, hist = sim.run(save_checkpoints=False, verbose=False)
+    sim.close()
+    assert int(s_k["completed_rounds"]) == 4
+    events = _events(tmp_path / "tel" / "events.jsonl")
+    degrades = [(e["state"], e.get("configured_depth", e.get("depth")))
+                for e in events if e["kind"] == "degrade"]
+    assert degrades == [("demoted", 3), ("repromoted", 3)]
+    assert [(h["broadcast"], h["ok"]) for h in hist] == \
+        [(h["broadcast"], h["ok"]) for h in hist_s]
+    assert _leaves_equal({"p": s_k["global_params"]},
                          {"p": s_sync["global_params"]})
 
 
@@ -427,21 +453,26 @@ def test_degraded_run_params_bit_identical(tmp_path):
 # kill-and-resume chaos: bit-identical continuation on all three executors
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("executor", ["sync", "pipelined", "fused"])
+@pytest.mark.parametrize("executor",
+                         ["sync", "pipelined", "pipelined_depth4", "fused"])
 def test_kill_and_resume_bit_identical(tmp_path, monkeypatch, executor):
     """Run 2 of 4 rounds, die (torn final checkpoint + orphaned temp),
     ``--resume``, finish — final params bit-identical to an uninterrupted
     run.  The torn entry forces the manifest fallback path: the resumed
     run restores round 1 and re-runs rounds 2-4 on the same rng
-    trajectory."""
+    trajectory.  ``pipelined_depth4`` is the ISSUE 10 chaos case: the
+    kill lands mid-queue (4 rounds in flight), and the torn-newest-entry
+    fallback still resumes byte-identically at depth k."""
     monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path / "tel"))
     (tmp_path / "tel").mkdir()
     tel = {"telemetry": dataclasses.replace(Config().telemetry, enabled=False)}
+    if executor == "pipelined_depth4":
+        tel["pipeline_depth"] = 4
 
     def run(cfg, sim, rounds):
         if executor == "sync":
             return sim.run(num_rounds=rounds, verbose=False)
-        if executor == "pipelined":
+        if executor.startswith("pipelined"):
             return sim.run(num_rounds=rounds, verbose=False, pipeline=True)
         return sim.run_fast(num_rounds=rounds, chunk_size=1, verbose=False)
 

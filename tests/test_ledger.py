@@ -130,6 +130,11 @@ def test_pipelined_and_resumed_runs_append_records(run_dir, tmp_path):
     record = _ledger_records(tmp_path)[-1]
     assert record["executor"] == "pipelined"
     assert record["rounds"] == 2
+    # depth provenance (ISSUE 10): configured + effective, from the
+    # schema-v8 run_header fields (no demotion here -> effective == k)
+    assert record["pipeline_depth"] == 1
+    assert record["pipeline_depth_effective"] == 1
+    assert record["pipeline_depth_configured"] == "1"
 
     resumed = Simulator(_cfg(tmp_path, resume=True))
     resumed.run(num_rounds=3, verbose=False)
@@ -360,6 +365,64 @@ def test_bench_ledger_append_helper(tmp_path, monkeypatch):
 def test_records_from_bench_rejects_contentless():
     assert records_from_bench({}) == []
     assert records_from_bench({"kind": "metric"}) == []
+
+
+def test_records_from_bench_depth_sweep_mapping():
+    """--depth-sweep -> one record per measured depth, each stamped with
+    its pipeline_depth so `ledger regress` never baselines across
+    depths."""
+    line = {"metric": "fl_depth_sweep_rounds_per_sec", "value": 3.4,
+            "unit": "rounds/s", "kind": "metric", "ts": 1.0,
+            "detail": {"config": "depth-sweep",
+                       "by_depth": {
+                           "0": {"rounds_per_sec_steady": 2.9,
+                                 "rounds_per_sec_mean": 2.8,
+                                 "per_rep": [2.7, 2.9]},
+                           "4": {"rounds_per_sec_steady": 3.4,
+                                 "rounds_per_sec_mean": 3.3,
+                                 "per_rep": [3.2, 3.4]}},
+                       "auto_pick": {"depth": 2, "ratio": 1.9}}}
+    records = records_from_bench(line)
+    assert [r["bench_variant"] for r in records] == ["depth0", "depth4"]
+    assert all(validate_record(r) == [] for r in records)
+    assert [r["pipeline_depth"] for r in records] == [0, 4]
+    assert records[1]["rounds_per_sec_steady"] == 3.4
+    assert records[1]["per_rep"] == [3.2, 3.4]
+    assert records[1]["auto_pick"]["depth"] == 2
+    # per-variant fingerprints: each depth gets its own baseline pool
+    assert records[0]["fingerprint"] != records[1]["fingerprint"]
+
+
+def test_import_committed_depth_sweep_artifact(tmp_path):
+    rc = ledger_main(["import", str(REPO / "BENCH_DEPTH.json"),
+                      "--dir", str(tmp_path)])
+    assert rc == 0
+    records, _ = LedgerStore(str(tmp_path)).load()
+    assert {r["pipeline_depth"] for r in records} == {0, 1, 2, 4, 8}
+    assert all(r["executor"] == "pipelined" for r in records)
+
+
+def test_rolling_baseline_depth_is_a_peer_key():
+    """ISSUE 10 (the PR 9 `cell` lesson): records at different pipeline
+    depths share a fingerprint — the knob is fingerprint-volatile — but
+    must NOT pool into one rolling baseline."""
+    def record(rid, depth, rate):
+        return {"record_id": rid, "fingerprint": "fp", "executor":
+                "pipelined", "pipeline_depth": depth,
+                "rounds_per_sec_steady": rate}
+
+    records = [record("d1-a", 1, 1.0), record("d1-b", 1, 1.1),
+               record("d4-a", 4, 2.0), record("d4-b", 4, 2.1),
+               record("sync-a", None, 0.9)]
+    candidate = record("d4-c", 4, 2.05)
+    baseline = rolling_baseline(records + [candidate], candidate)
+    assert set(baseline["baseline_of"]) == {"d4-a", "d4-b"}
+    assert baseline["pipeline_depth"] == 4
+    assert baseline["rounds_per_sec_steady"] == 2.05
+    # depth-None (non-pipelined) records keep matching each other
+    none_candidate = record("sync-b", None, 0.95)
+    baseline = rolling_baseline(records + [none_candidate], none_candidate)
+    assert set(baseline["baseline_of"]) == {"sync-a"}
 
 
 # ---------------------------------------------------------------------------

@@ -175,6 +175,45 @@ def test_disabled_telemetry_has_no_monitor(tmp_path, monkeypatch):
     assert leftovers <= {"app.log"}, leftovers  # console log only, no telemetry
 
 
+def test_pipeline_depth_gauge(monitor):
+    """ISSUE 10: the effective-depth gauge rides /metrics and
+    /last-round — absent before the pipelined executor reports one,
+    tracking demote (0) / re-promote (k) transitions after."""
+    monitor.run_started()
+    monitor.record_round({"round": 1, "broadcast": 1, "ok": True,
+                          "seconds": 0.1})
+    assert "attackfl_pipeline_depth" not in monitor.metrics_text()
+    assert "pipeline_depth" not in monitor.last_round()
+    monitor.set_pipeline_depth(4)
+    assert "attackfl_pipeline_depth 4" in monitor.metrics_text()
+    code, body = get(monitor.port, "/metrics")
+    assert code == 200 and b"attackfl_pipeline_depth 4" in body
+    code, body = get(monitor.port, "/last-round")
+    assert json.loads(body)["pipeline_depth"] == 4
+    monitor.set_pipeline_depth(0)  # demoted
+    assert "attackfl_pipeline_depth 0" in monitor.metrics_text()
+    assert monitor.last_round()["pipeline_depth"] == 0
+
+
+def test_watch_prints_depth_and_degrade(monitor, capsys):
+    from attackfl_tpu import cli
+
+    monitor.run_started()
+    monitor.set_pipeline_depth(2)
+    monitor.record_round({"round": 3, "broadcast": 3, "ok": True,
+                          "seconds": 0.1})
+    url = f"http://127.0.0.1:{monitor.port}"
+    assert cli.watch_main([url, "--once"]) == 0
+    assert "depth=2" in capsys.readouterr().out
+    # demoted: watch surfaces the transition with the depth evidence
+    monitor.set_degraded({"round": 3, "consecutive_failures": 3,
+                          "depth": 0, "configured_depth": 2})
+    monitor.set_pipeline_depth(0)
+    assert cli.watch_main([url, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out and "depth 0" in out and "configured 2" in out
+
+
 def test_watch_cli_once(monitor, capsys):
     from attackfl_tpu import cli
 

@@ -1,8 +1,11 @@
-"""Pipelined round executor (ISSUE 3): parity with the synchronous path
-(final params, per-round ok flags, rollback on an injected failed round),
-validation scheduling (validation_every / validation_async), the
-persistent compile cache hookup, and the reload mtime cache."""
+"""Pipelined round executor (ISSUE 3 depth-1, ISSUE 10 depth-k): parity
+with the synchronous path (final params, per-round ok flags, rollback on
+an injected failed round) at every depth, the ledger-driven `auto` depth
+resolution, demote/re-promote targeting the configured depth, validation
+scheduling (validation_every / validation_async), the persistent compile
+cache hookup, and the reload mtime cache."""
 
+import dataclasses
 import json
 import os
 
@@ -12,7 +15,7 @@ import numpy as np
 import pytest
 
 from attackfl_tpu.config import AttackSpec, Config
-from attackfl_tpu.training.engine import Simulator
+from attackfl_tpu.training.engine import Simulator, auto_depth_from_records
 from attackfl_tpu.utils import checkpoint as ckpt
 
 BASE = dict(
@@ -116,6 +119,216 @@ def test_pipeline_hyper_mode():
                                          verbose=False, pipeline=True)
     assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p] == [True] * 2
     _assert_state_equal(state_s["hnet_params"], state_p["hnet_params"])
+
+
+# ---------------------------------------------------------------------------
+# depth-k (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["fedavg", "hyper"])
+def test_depth_k_params_bit_identical_to_sync(mode):
+    """Acceptance: params bit-identical sync vs depth-k for k in {1,2,4}
+    on the parity configs (fedavg with an active attacker + hyper).  ONE
+    sync reference run per mode — every depth is held to the same
+    trajectory.  Validation is off to keep the tier-1 budget (it never
+    feeds the params math; the depth-1 tests above keep it on)."""
+    attacks = (() if mode == "hyper" else
+               (AttackSpec(mode="LIE", num_clients=1, attack_round=2),))
+    cfg = Config(num_round=3, total_clients=3, mode=mode, attacks=attacks,
+                 validation=False, **BASE)
+    state_s, hist_s = Simulator(cfg).run(save_checkpoints=False,
+                                         verbose=False, pipeline=False)
+    key = "hnet_params" if mode == "hyper" else "global_params"
+    # ONE pipelined Simulator serves every depth (depth is host-side
+    # queue discipline over the same cached step program — the property
+    # the retrace guard also holds the executor to).  Hyper skips k=1:
+    # test_pipeline_hyper_mode already gates the depth-1 default.
+    sim = Simulator(cfg.replace(pipeline=True))
+    for depth in ((2, 4) if mode == "hyper" else (1, 2, 4)):
+        state = sim._ensure_numerics_state(sim.init_state())
+        state_p, hist_p = sim._run_pipelined(
+            cfg.num_round, state, save_checkpoints=False, verbose=False,
+            depth=depth)
+        assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p], depth
+        assert int(state_p["broadcasts"]) == int(state_s["broadcasts"])
+        _assert_state_equal(state_s[key], state_p[key])
+
+
+def test_depth_k_rollback_mid_queue_matches_sync():
+    """A failure landing while k rounds are in flight: the device-side
+    accept-select makes the already-dispatched successors correct without
+    any re-dispatch — ok sequence and final params match sync.  Also
+    covers depth > remaining rounds (the queue never overfills)."""
+    cfg = Config(num_round=4, total_clients=3, mode="fedavg",
+                 validation=False, **BASE)
+    sim_s, sim_p = Simulator(cfg), \
+        Simulator(cfg.replace(pipeline=True, pipeline_depth=4))
+    _poison_broadcast(sim_s, 3)
+    _poison_broadcast(sim_p, 3)
+    state_s, hist_s = sim_s.run(save_checkpoints=False, verbose=False,
+                                pipeline=False)
+    state_p, hist_p = sim_p.run(save_checkpoints=False, verbose=False)
+    assert [h["ok"] for h in hist_s] == [h["ok"] for h in hist_p]
+    assert int(state_p["completed_rounds"]) == 4
+    assert int(state_p["broadcasts"]) == int(state_s["broadcasts"]) == 5
+    _assert_state_equal(state_s["global_params"], state_p["global_params"])
+
+
+def test_repromotion_targets_configured_depth_without_retracing(
+        tmp_path, monkeypatch, capsys):
+    """Regression (ISSUE 10 satellite): re-promotion used to announce and
+    target depth-1; it must return to the CONFIGURED depth.  The same run
+    doubles as the acceptance retrace gate: healthy -> demoted ->
+    re-promoted shows zero post-warmup jit-cache growth (every depth
+    dispatches the one cached step program)."""
+    from attackfl_tpu.analysis.retrace import RetraceGuard
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    cfg = Config(num_round=4, total_clients=3, mode="fedavg", pipeline=True,
+                 pipeline_depth=3, pipeline_demote_after=2,
+                 pipeline_repromote_after=2, validation=False, **BASE)
+    sim = Simulator(cfg)
+    # two consecutive poisoned broadcasts (2, 3) -> demote; the clean
+    # rounds after re-promote back to the configured depth
+    inner = sim._round_step_raw
+
+    def wrapped(global_params, prev_genuine, have_genuine, rng, b):
+        stacked, sizes, new_genuine, ok, loss = inner(
+            global_params, prev_genuine, have_genuine, rng, b)
+        fail = (b == 2) | (b == 3)
+        return (stacked, sizes, new_genuine, ok & ~fail,
+                jnp.where(fail, jnp.nan, loss))
+
+    wrapped.telemetry_info = getattr(inner, "telemetry_info", None)
+    sim._round_step_raw = wrapped
+    sim.round_step = jax.jit(wrapped)
+    state, _ = sim.run(num_rounds=1, save_checkpoints=False, verbose=False)
+    guard = RetraceGuard(sim)
+    guard.snapshot()
+    state, hist = sim.run(num_rounds=4, state=state, save_checkpoints=False,
+                          verbose=False)
+    # acceptance: depth changes within the run (3 -> 0 -> 3) retraced
+    # nothing after the warm-up round
+    assert guard.violations() == []
+    sim.close()
+    assert int(state["completed_rounds"]) == 4
+    events = [json.loads(line) for line in
+              open(os.path.join(str(tmp_path), "events.jsonl"))]
+    degrades = [e for e in events if e["kind"] == "degrade"]
+    assert [e["state"] for e in degrades] == ["demoted", "repromoted"]
+    assert degrades[0]["configured_depth"] == 3 and degrades[0]["depth"] == 0
+    assert degrades[1]["depth"] == 3  # NOT 1: the configured depth
+    header = next(e for e in events if e["kind"] == "run_header")
+    assert header["pipeline_depth"] == 3
+    assert header["pipeline_depth_configured"] == "3"
+    out = capsys.readouterr().out
+    assert "re-promoted to depth-3" in out
+    assert "re-promoted to depth-1" not in out
+
+
+# ---------------------------------------------------------------------------
+# `auto` depth resolution (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _depth_records(fingerprint, device, host, n=3):
+    return [{"ledger_schema": 1, "source": "run", "executor": "pipelined",
+             "fingerprint": fingerprint, "rounds": 5, "ok_rounds": 5,
+             "time_attribution": {}, "counts": {},
+             "round_device_time": device, "host_resolution_latency": host}
+            for _ in range(n)]
+
+
+def test_auto_depth_from_records_formula():
+    records = _depth_records("fp", device=0.1, host=0.35)
+    k, info = auto_depth_from_records(records, "fp")
+    assert k == 4 and info["ratio"] == 3.5 and info["peers"] == 3
+    # host cheaper than device -> depth 1 still overlaps the resolve
+    k, _ = auto_depth_from_records(_depth_records("fp", 0.5, 0.1), "fp")
+    assert k == 1
+    # wrong fingerprint / missing inputs -> no pick
+    k, info = auto_depth_from_records(records, "other")
+    assert k is None and info["reason"] == "no_ledger_peers"
+    assert auto_depth_from_records([], "fp")[0] is None
+
+
+def test_auto_depth_resolves_from_ledger_and_clamps(tmp_path, monkeypatch):
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("ATTACKFL_LEDGER_DIR", str(tmp_path / "ledger"))
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg", pipeline=True,
+                 pipeline_depth="auto", checkpoint_async=True,
+                 validation=False, **BASE)
+    store = LedgerStore(str(tmp_path / "ledger"))
+    for record in _depth_records(ckpt.config_fingerprint(cfg), 0.1, 0.35):
+        store.append(record)
+    sim = Simulator(cfg)
+    assert sim.resolve_pipeline_depth(save_checkpoints=True) == 4
+    sim.close()
+
+    # per-round SYNCHRONOUS checkpointing clamps auto to 2 (the gather +
+    # write + fsync rides every resolve — deeper just queues behind it)
+    sim = Simulator(cfg.replace(checkpoint_async=False))
+    assert sim.resolve_pipeline_depth(save_checkpoints=True) == 2
+    assert sim._depth_info["clamped_from"] == 4
+    sim.close()
+
+    # an empty ledger falls back to depth 1, loudly but harmlessly
+    monkeypatch.setenv("ATTACKFL_LEDGER_DIR", str(tmp_path / "none"))
+    sim = Simulator(cfg)
+    assert sim.resolve_pipeline_depth(save_checkpoints=False) == 1
+    sim.close()
+
+
+def test_auto_depth_clamped_by_numerics_window(tmp_path, monkeypatch):
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("ATTACKFL_LEDGER_DIR", str(tmp_path / "ledger"))
+    cfg = Config(num_round=1, total_clients=3, mode="fedavg", pipeline=True,
+                 pipeline_depth="auto", validation=False,
+                 telemetry=dataclasses.replace(Config().telemetry,
+                                               numerics=True,
+                                               numerics_window=3), **BASE)
+    store = LedgerStore(str(tmp_path / "ledger"))
+    for record in _depth_records(ckpt.config_fingerprint(cfg), 0.1, 0.8):
+        store.append(record)  # ratio 8 -> raw pick 8
+    sim = Simulator(cfg)
+    assert sim.resolve_pipeline_depth(save_checkpoints=False) == 3
+    assert sim._depth_info["clamped_from"] == 8
+    sim.close()
+
+
+def test_v8_header_depth_fields_type_checked():
+    from attackfl_tpu.telemetry.events import (
+        KINDS_BY_VERSION, SCHEMA_VERSION, known_kinds, validate_event,
+    )
+
+    assert SCHEMA_VERSION >= 8
+    assert KINDS_BY_VERSION[8] == frozenset()  # optional fields only
+    assert known_kinds(8) == known_kinds(7)
+    good = {"schema": 8, "kind": "run_header", "ts": 1.0, "run_id": "r",
+            "backend": "cpu", "num_devices": 1, "mode": "fedavg",
+            "model": "CNNModel", "data_name": "ICU",
+            "pipeline_depth": 4, "pipeline_depth_configured": "auto"}
+    assert validate_event(good) == []
+    assert any("pipeline_depth" in p
+               for p in validate_event(dict(good, pipeline_depth="4")))
+    # v7-shaped headers (no depth fields) stay green
+    v7 = {k: v for k, v in good.items()
+          if not k.startswith("pipeline_depth")}
+    assert validate_event(dict(v7, schema=7)) == []
+
+
+def test_pipeline_depth_config_validation():
+    assert Config(pipeline_depth="auto", **BASE).pipeline_depth == "auto"
+    assert Config(pipeline_depth="4", **BASE).pipeline_depth == 4
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Config(pipeline_depth=-1, **BASE)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Config(pipeline_depth="fast", **BASE)
 
 
 # ---------------------------------------------------------------------------
