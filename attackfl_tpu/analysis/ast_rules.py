@@ -71,6 +71,11 @@ SERVICE_DIR = REPO / "attackfl_tpu" / "service"
 # audited materialization lives in training/matrix_exec.py, which the
 # TRAINING glob already covers with its own allowlist entries below)
 MATRIX_DIR = REPO / "attackfl_tpu" / "matrix"
+# the cost observatory (ISSUE 11): capture reads XLA analysis objects
+# and the estimate/report halves do pure JSON arithmetic — neither may
+# ever materialize a device value (NO allowlist by design; profiling a
+# program is lower+compile, not dispatch)
+COSTMODEL_DIR = REPO / "attackfl_tpu" / "costmodel"
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -252,7 +257,8 @@ def resolve_host_sync_allowlist() -> list[Finding]:
 def host_sync_files() -> list[Path]:
     return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
             + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py"))
-            + sorted(MATRIX_DIR.glob("*.py")))
+            + sorted(MATRIX_DIR.glob("*.py"))
+            + sorted(COSTMODEL_DIR.glob("*.py")))
 
 
 @register(

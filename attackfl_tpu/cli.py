@@ -387,6 +387,14 @@ def watch_main(argv=None) -> int:
             _, last = _http_get_json(base + "/last-round")
         except Exception:  # noqa: BLE001 — health is the primary signal
             last = {}
+        # cost observatory (ISSUE 11): live roofline estimate from
+        # /programs — printed next to each round so a collapsing
+        # utilization is visible as it happens, not post-mortem
+        try:
+            _, cost = _http_get_json(base + "/programs")
+        except Exception:  # noqa: BLE001 — optional endpoint
+            cost = {}
+        utilization = cost.get("utilization") or {}
         if code == 503:
             if not stalled:
                 print_with_color(f"[watch] STALL detected: {health}", "red")
@@ -432,6 +440,13 @@ def watch_main(argv=None) -> int:
                         + "]")
             if isinstance(depth, int):
                 msg += f" depth={depth}"
+            fraction = utilization.get("utilization_flops")
+            achieved = utilization.get("achieved_flops_per_sec")
+            if isinstance(fraction, (int, float)):
+                msg += f" util={100 * fraction:.1f}%"
+            elif isinstance(achieved, (int, float)):
+                # no peak spec for this device kind (CPU): achieved-only
+                msg += f" flops/s={achieved:.3g}"
             print(f"[watch] round {rnd} ok={last.get('ok')} "
                   f"{msg}".rstrip(), flush=True)
         if args.once:
@@ -481,6 +496,17 @@ def matrix_main(argv=None) -> int:
     return _matrix_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def cost_main(argv=None) -> int:
+    """``attackfl-tpu cost``: the predictive cost model (ISSUE 11) —
+    ``estimate`` prices a config or matrix grid WITHOUT running it
+    (fingerprint-peer ledger records, flops/bytes regression fallback),
+    ``validate`` replays the predictor leave-one-out over a ledger
+    corpus and gates on the median error factor (default 2x)."""
+    from attackfl_tpu.costmodel.cli import main as _cost_main
+
+    return _cost_main(list(sys.argv[1:] if argv is None else argv))
+
+
 def ledger_main(argv=None) -> int:
     """``attackfl-tpu ledger``: the persistent cross-run store —
     ``list``/``show`` query it, ``compare`` diffs two runs (or a run
@@ -500,6 +526,7 @@ _SUBCOMMANDS = {
     "watch": watch_main,
     "audit": audit_main,
     "ledger": ledger_main,
+    "cost": cost_main,
     "matrix": matrix_main,
     "serve": serve_main,
     "job": job_main,
@@ -520,6 +547,9 @@ commands:
   ledger   persistent cross-run store: list/show records, compare two runs
            (perf + numerics + forensics columns), regress = CI gate with
            noise-aware thresholds, import = backfill BENCH_*.json
+  cost     predictive cost model: estimate = price a config or matrix grid
+           without running it (peer ledger records, flops/bytes regression
+           fallback); validate = leave-one-out accuracy gate on a ledger
   matrix   scenario-matrix engine: run a full (attack x defense x seed)
            grid as ONE compiled program (per-cell ledger records share a
            sweep_id); status renders the grid's completion table

@@ -137,6 +137,15 @@ class TelemetryConfig:
     numerics_window: int = 16
     ledger: bool = True
     ledger_dir: str = ""
+    # cost observatory (ISSUE 11, default on): guarded
+    # cost_analysis/memory_analysis snapshots at the AOT-compile seams,
+    # emitted as schema-v9 `program_profile` events and folded into the
+    # ledger record (attackfl_tpu/costmodel).  Purely observational —
+    # params are bit-identical on vs off; the only cost is one extra
+    # AOT compile of the synchronous-path programs (a persistent-cache
+    # hit when compile_cache_dir is set; the fused/pipelined/matrix
+    # executors profile the executable they dispatch anyway, for free).
+    costmodel: bool = True
 
     def __post_init__(self):
         if self.sample_every < 1:
@@ -723,6 +732,7 @@ def config_from_dict(raw: dict) -> Config:
             numerics_window=int(_get(tele, "numerics-window", 16)),
             ledger=bool(_get(tele, "ledger", True)),
             ledger_dir=str(_get(tele, "ledger-dir", "")),
+            costmodel=bool(_get(tele, "costmodel", True)),
         ),
         service=ServiceConfig(
             spool_dir=str(_get(svc, "spool-dir", "")),

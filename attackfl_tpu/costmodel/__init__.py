@@ -1,0 +1,41 @@
+"""Program cost observatory (ISSUE 11): the fifth observability layer.
+
+Three halves, one contract:
+
+* **capture** (:mod:`~attackfl_tpu.costmodel.capture`) — guarded
+  ``compiled.cost_analysis()`` + ``memory_analysis()`` snapshots taken at
+  the engines' existing AOT-compile seams, emitted as schema-v9
+  ``program_profile`` events keyed by program name + config fingerprint
+  and folded into the cross-run ledger record;
+* **utilization** (:mod:`~attackfl_tpu.costmodel.roofline` +
+  :mod:`~attackfl_tpu.costmodel.peaks`) — the static profile combined
+  with the ledger's MEASURED ``round_device_time`` into achieved FLOP/s
+  and bytes/s, and — on device types with a known peak spec — roofline
+  utilization fractions (CPU reports achieved-only: no honest peak
+  exists for a shared, frequency-scaled host);
+* **prediction** (:mod:`~attackfl_tpu.costmodel.estimate`) —
+  ``attackfl-tpu cost estimate`` prices a config or matrix grid WITHOUT
+  running it (fingerprint-peer ledger records first, a flops/bytes
+  regression over non-peer records as the fallback) and ``cost
+  validate`` replays predictions against a ledger corpus, reporting the
+  error distribution the future multi-tenant scheduler's bin-packing
+  will rely on.
+
+Standing invariants: everything here is observational — zero new host
+syncs (compiling/lowering never materializes device values; the
+host-sync lint covers this package with NO allowlist) and params are
+bit-identical with the observatory on or off.
+"""
+
+from attackfl_tpu.costmodel.capture import (
+    compiled_profile, guarded_cost_analysis, guarded_memory_analysis,
+)
+from attackfl_tpu.costmodel.peaks import peak_for
+from attackfl_tpu.costmodel.roofline import (
+    per_round_cost, utilization_summary,
+)
+
+__all__ = [
+    "compiled_profile", "guarded_cost_analysis", "guarded_memory_analysis",
+    "peak_for", "per_round_cost", "utilization_summary",
+]

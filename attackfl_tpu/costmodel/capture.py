@@ -1,0 +1,111 @@
+"""Guarded XLA compiled-program introspection — the ONE shared guard.
+
+``compiled.cost_analysis()`` and ``compiled.memory_analysis()`` both
+drift across JAX/backend versions (ADVICE.md finding 3: return None,
+raise, or change shape — cost_analysis returns a list of dicts on some
+backends and a bare dict on others).  Every caller in the tree goes
+through this module so version drift degrades to a PARTIAL profile
+instead of killing the run: a raising ``cost_analysis`` still yields the
+memory half, and vice versa (regression-tested in
+tests/test_costmodel.py).
+
+This is the factored-out successor of the guarded ``memory_analysis``
+helper that lived in ``telemetry/xla.py`` (and was duplicated in spirit
+by ``scripts/config5_footprint.py``); ``telemetry.xla.
+memory_analysis_bytes`` is now a shim over :func:`guarded_memory_analysis`.
+
+Deliberately jax-free at import time: it only touches the ``compiled``
+object it is handed, so the jax-free reporting/estimation halves of the
+costmodel can import the module without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# CompiledMemoryStats attributes -> profile keys (device-side sizes; the
+# host_* mirror attributes exist on newer jaxlibs but are zero for the
+# programs we compile and are deliberately not recorded).
+_BYTE_ATTRS = (
+    ("argument", "argument_size_in_bytes"),
+    ("output", "output_size_in_bytes"),
+    ("temp", "temp_size_in_bytes"),
+    ("alias", "alias_size_in_bytes"),
+    ("generated_code", "generated_code_size_in_bytes"),
+)
+
+# cost_analysis keys -> profile keys.  Per-operand entries like
+# "bytes accessed0{}" are operand detail, not program totals — skipped.
+_COST_KEYS = (
+    ("flops", "flops"),
+    ("transcendentals", "transcendentals"),
+    ("bytes accessed", "bytes_accessed"),
+)
+
+
+def _number(value: Any) -> int | None:
+    """Plain non-negative int out of an XLA stat (never ``float(...)`` —
+    these are host analysis values, but the host-sync lint covers this
+    package with no allowlist, so stay trivially clean)."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    if value != value or value < 0:  # NaN / sentinel negatives
+        return None
+    return int(value)
+
+
+def guarded_cost_analysis(compiled: Any) -> dict[str, int] | None:
+    """``{flops, transcendentals, bytes_accessed}`` (whichever keys the
+    backend reports) from ``compiled.cost_analysis()``, or None.  Never
+    raises; handles both the list-of-dicts and bare-dict return shapes.
+    """
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — unimplemented on some backends
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    out: dict[str, int] = {}
+    for key, name in _COST_KEYS:
+        value = _number(analysis.get(key))
+        if value is not None:
+            out[name] = value
+    return out or None
+
+
+def guarded_memory_analysis(compiled: Any) -> dict[str, int] | None:
+    """Byte sizes from ``compiled.memory_analysis()`` plus the derived
+    ``peak`` (argument + output + temp + alias: the scheduler-visible
+    resident upper bound XLA planned for one dispatch), or None when the
+    backend provides nothing.  Never raises."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — unimplemented on some backends
+        return None
+    if analysis is None:
+        return None
+    out: dict[str, int] = {}
+    for key, attr in _BYTE_ATTRS:
+        value = _number(getattr(analysis, attr, None))
+        if value is not None:
+            out[key] = value
+    if out:
+        out["peak"] = sum(out.get(k, 0)
+                          for k in ("argument", "output", "temp", "alias"))
+    return out or None
+
+
+def compiled_profile(compiled: Any) -> dict[str, Any] | None:
+    """One program's static cost/memory profile: the union of both
+    guarded analyses.  A raising/absent half degrades to a PARTIAL
+    profile; None only when neither analysis yields anything."""
+    profile: dict[str, Any] = {}
+    cost = guarded_cost_analysis(compiled)
+    if cost:
+        profile.update(cost)
+    memory = guarded_memory_analysis(compiled)
+    if memory:
+        profile["memory"] = memory
+    return profile or None

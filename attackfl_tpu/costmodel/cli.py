@@ -1,0 +1,275 @@
+"""``attackfl-tpu cost estimate|validate``: the predictive front door.
+
+``estimate`` prices a config — or, with ``--matrix``, a whole
+(attack × defense × seed) grid — WITHOUT running it: fingerprint-peer
+ledger records first (their median measured ``round_device_time``), a
+flops/bytes regression over non-peer records when the config is new.
+The no-peer path needs the candidate's static profile, which means
+AOT-compiling its round programs (compile ≠ run: no round executes, no
+state advances, no device value is materialized); ``--no-compile``
+suppresses that and reports the config as unpredictable instead.
+
+``validate`` is the accuracy contract: leave-one-out replay of the
+predictor over a ledger corpus, exit 1 when the median symmetric error
+factor exceeds ``--max-median-factor`` (default 2× — the bound the
+multi-tenant scheduler's bin-packing is allowed to rely on), exit 2 when
+the corpus has nothing measurable.  Jax-free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Any
+
+from attackfl_tpu.costmodel.estimate import (
+    DEFAULT_MAX_MEDIAN_FACTOR, predict_run, validate_predictions,
+)
+
+
+def _load_records(directory: str | None) -> tuple[list[dict[str, Any]], str]:
+    from attackfl_tpu.ledger.store import LedgerStore, resolve_ledger_dir
+
+    resolved = directory or resolve_ledger_dir()
+    store = LedgerStore(resolved)
+    records, _ = store.load()
+    return records, resolved
+
+
+def profile_config(cfg) -> dict[str, Any] | None:
+    """AOT-compile the config's synchronous round programs (telemetry
+    off, nothing runs) and fold them into a per-round cost profile — the
+    regression fallback's input.  None when the backend reports no cost
+    stats."""
+    from attackfl_tpu.costmodel.capture import compiled_profile
+    from attackfl_tpu.costmodel.roofline import per_round_cost
+    from attackfl_tpu.training.engine import Simulator
+
+    quiet = cfg.replace(
+        telemetry=dataclasses.replace(cfg.telemetry, enabled=False,
+                                      monitor=False))
+    sim = Simulator(quiet)
+    try:
+        programs: dict[str, dict[str, Any]] = {}
+        for name, fn, args in sim.sync_profile_programs():
+            try:
+                profile = compiled_profile(fn.lower(*args).compile())
+            except Exception:  # noqa: BLE001 — profiling is best-effort
+                profile = None
+            if profile:
+                profile["rounds_per_dispatch"] = 1
+                programs[name] = profile
+        return per_round_cost(programs)
+    finally:
+        sim.close()
+
+
+def _estimate_one(records, fingerprint: str, rounds: int,
+                  cfg, compile_ok: bool) -> dict[str, Any]:
+    prediction = predict_run(records, fingerprint, rounds)
+    if prediction is None and compile_ok and cfg is not None:
+        profile = profile_config(cfg)
+        if profile is not None:
+            prediction = predict_run(records, fingerprint, rounds,
+                                     profile=profile)
+            if prediction is not None:
+                prediction["profile"] = {
+                    k: profile.get(k)
+                    for k in ("flops_per_round", "bytes_per_round")}
+    if prediction is None:
+        return {"fingerprint": fingerprint, "rounds": rounds,
+                "method": "unpredictable"}
+    return {"fingerprint": fingerprint, **prediction}
+
+
+def estimate_main(args) -> int:
+    import yaml
+
+    from attackfl_tpu.config import load_config
+    from attackfl_tpu.utils.fingerprint import config_fingerprint
+
+    cfg = load_config(args.config)
+    if args.rounds is not None:
+        cfg = cfg.replace(num_round=args.rounds)
+    records, directory = _load_records(args.dir)
+    out: dict[str, Any] = {"ledger": directory,
+                           "ledger_records": len(records)}
+
+    if args.matrix:
+        from attackfl_tpu.matrix.grid import (
+            cell_config, expand_cells, grid_from_dict,
+        )
+
+        with open(args.config) as fh:
+            raw = yaml.safe_load(fh) or {}
+        grid = grid_from_dict(dict(raw.get("matrix") or {}))
+        cells = expand_cells(grid)
+        per_cell = []
+        total = 0.0
+        predictable = 0
+        for cell in cells:
+            ccfg = cell_config(cfg, cell, rounds=grid.rounds)
+            estimate = _estimate_one(
+                records, config_fingerprint(ccfg), grid.rounds,
+                # one compile covers the grid: cells share the round
+                # program shape, so the FIRST no-peer cell's profile
+                # prices its siblings too (flops differ only by the
+                # defense branch — second-order)
+                ccfg if predictable == 0 else None,
+                not args.no_compile)
+            estimate["cell"] = cell.key
+            per_cell.append(estimate)
+            wall = estimate.get("predicted_wall_seconds")
+            if wall is not None:
+                total += wall
+                predictable += 1
+        out.update({
+            "grid": grid.describe(),
+            "cells": per_cell,
+            "predictable_cells": predictable,
+            # serial bound: the batched sweep executor shares compiles
+            # and vmaps the cell axis, so the real sweep lands at or
+            # under this (BENCH_MATRIX: 1.52x cold)
+            "predicted_sweep_wall_seconds_serial_bound": round(total, 3),
+        })
+    else:
+        estimate = _estimate_one(records, config_fingerprint(cfg),
+                                 cfg.num_round, cfg, not args.no_compile)
+        out.update(estimate)
+
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(format_estimate(out))
+    return 0 if out.get("method") != "unpredictable" else 2
+
+
+def format_estimate(out: dict[str, Any]) -> str:
+    lines = [f"cost estimate — ledger {out['ledger']} "
+             f"({out['ledger_records']} record(s))"]
+    if "cells" in out:
+        lines.append(
+            f"matrix grid: {out['grid']['n_cells']} cells x "
+            f"{out['grid']['rounds']} rounds")
+        for cell in out["cells"]:
+            wall = cell.get("predicted_wall_seconds")
+            lines.append(
+                f"  {cell['cell']:<32} "
+                + (f"{wall:>9.2f}s  [{cell.get('method')}]"
+                   if wall is not None else "unpredictable "
+                   "(no peer, no profile)"))
+        lines.append(
+            f"predicted sweep wall (serial bound): "
+            f"{out['predicted_sweep_wall_seconds_serial_bound']}s over "
+            f"{out['predictable_cells']} predictable cell(s)")
+        return "\n".join(lines)
+    if out.get("method") == "unpredictable":
+        lines.append("unpredictable: no fingerprint peer in the ledger and "
+                     "no static profile to regress on (run once with "
+                     "telemetry.ledger on, or drop --no-compile)")
+        return "\n".join(lines)
+    lines.append(
+        f"method: {out['method']}"
+        + (f" over {out['peers']} peer record(s)" if "peers" in out else "")
+        + (f" fit on {out['fit_records']} record(s)"
+           if "fit_records" in out else ""))
+    lines.append(
+        f"per-round: device={out['round_device_time']}s"
+        + (f" host={out['host_resolution_latency']}s"
+           if out.get("host_resolution_latency") is not None
+           else " (device-only: no host-latency peer)"))
+    lines.append(f"predicted wall for {out['rounds']} round(s): "
+                 f"{out['predicted_wall_seconds']}s")
+    return "\n".join(lines)
+
+
+def validate_main(args) -> int:
+    records, directory = _load_records(args.dir)
+    report = validate_predictions(records, window=args.window)
+    report["ledger"] = directory
+    ok = (report["predicted"] > 0
+          and report["median_error_factor"] is not None
+          and report["median_error_factor"] <= args.max_median_factor)
+    if args.json:
+        print(json.dumps({**report, "ok": ok,
+                          "max_median_factor": args.max_median_factor},
+                         indent=1))
+    else:
+        lines = [f"cost validate — ledger {directory}: "
+                 f"{report['predicted']}/{report['records']} record(s) "
+                 f"predicted leave-one-out "
+                 f"({report['unpredictable']} unpredictable)"]
+        if report["median_error_factor"] is not None:
+            lines.append(
+                f"error factor: median={report['median_error_factor']}x "
+                f"p90={report['p90_error_factor']}x "
+                f"worst={report['worst_error_factor']}x "
+                f"(bound {args.max_median_factor}x: "
+                + ("PASS" if ok else "FAIL") + ")")
+        by_method = ", ".join(f"{k}={v}" for k, v in
+                              sorted(report["by_method"].items()))
+        if by_method:
+            lines.append(f"paths: {by_method}")
+        for row in report["rows"]:
+            predicted = row.get("predicted_s")
+            lines.append(
+                f"  {str(row.get('record_id'))[:28]:<29}"
+                f"measured={row['measured_s']:<10} "
+                + (f"predicted={predicted:<10} "
+                   f"x{row['error_factor']} [{row['method']}]"
+                   if predicted is not None else "[unpredictable]"))
+        print("\n".join(lines))
+    if report["predicted"] == 0:
+        print("nothing to validate: no record carries a measured "
+              "round_device_time", file=sys.stderr)
+        return 2
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="attackfl-tpu cost",
+        description="Predictive cost model over the cross-run ledger: "
+                    "estimate a config or matrix grid without running "
+                    "it; validate the predictor against a ledger corpus.")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_est = sub.add_parser("estimate",
+                           help="predict per-round device time and wall "
+                                "time for a config (or --matrix grid)")
+    p_est.add_argument("--config", type=str, default="config.yaml")
+    p_est.add_argument("--rounds", type=int, default=None,
+                       help="override num-round for the wall prediction")
+    p_est.add_argument("--matrix", action="store_true",
+                       help="price the config's matrix: grid per cell")
+    p_est.add_argument("--dir", type=str, default=None,
+                       help="ledger directory (default: "
+                            "$ATTACKFL_LEDGER_DIR or ./ledger)")
+    p_est.add_argument("--no-compile", action="store_true",
+                       help="never AOT-compile for a profile; peerless "
+                            "configs report as unpredictable")
+    p_est.add_argument("--json", action="store_true")
+
+    p_val = sub.add_parser("validate",
+                           help="leave-one-out accuracy replay over a "
+                                "ledger corpus (exit 1 past the bound)")
+    p_val.add_argument("--dir", type=str, default=None)
+    p_val.add_argument("--window", type=int, default=5,
+                       help="peer-median window (records)")
+    p_val.add_argument("--max-median-factor", type=float,
+                       default=DEFAULT_MAX_MEDIAN_FACTOR,
+                       help="median error-factor bound (default 2.0)")
+    p_val.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+    if args.command == "estimate":
+        return estimate_main(args)
+    if args.command == "validate":
+        return validate_main(args)
+    raise AssertionError(f"unhandled command {args.command!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

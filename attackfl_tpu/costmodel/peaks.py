@@ -1,0 +1,54 @@
+"""Peak-spec table: what the silicon could do, per device kind.
+
+Per-chip dense peak FLOP/s (bf16 — the matrix-unit rate every published
+TPU spec quotes) and HBM bandwidth for the device kinds this project can
+land on, keyed by the substrings ``jax.devices()[0].device_kind`` uses.
+The roofline layer divides achieved FLOP/s and bytes/s by these to get
+utilization fractions.
+
+**Extending the table for a new device type**: add one entry mapping a
+lowercase substring of the new kind string to its per-chip
+``flops_per_sec`` / ``bytes_per_sec`` (from the vendor spec sheet), and
+it is picked up everywhere — the monitor gauges, ``metrics --programs``,
+``ledger`` utilization columns and the regress gate.  Kinds with no
+entry (CPU above all) report ACHIEVED-only: a shared, frequency-scaled
+host has no honest peak, and a made-up one would turn the utilization
+gate into noise.
+
+Values are marketing-sheet peaks, deliberately so: utilization numbers
+are comparable across papers exactly because everyone divides by the
+same published figure.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# lowercase device_kind substring -> per-chip peak spec.  Ordered
+# longest-match-first at lookup so "tpu v5p" never matches a bare "v5".
+PEAK_SPECS: dict[str, dict[str, float]] = {
+    # kind strings observed from jax: "TPU v2", "TPU v3", "TPU v4",
+    # "TPU v4i", "TPU v5 lite" (v5e), "TPU v5p", "TPU v6 lite" (v6e)
+    "tpu v2": {"flops_per_sec": 45e12, "bytes_per_sec": 700e9},
+    "tpu v3": {"flops_per_sec": 123e12, "bytes_per_sec": 900e9},
+    "tpu v4i": {"flops_per_sec": 138e12, "bytes_per_sec": 614e9},
+    "tpu v4": {"flops_per_sec": 275e12, "bytes_per_sec": 1228e9},
+    "tpu v5 lite": {"flops_per_sec": 197e12, "bytes_per_sec": 819e9},
+    "tpu v5e": {"flops_per_sec": 197e12, "bytes_per_sec": 819e9},
+    "tpu v5p": {"flops_per_sec": 459e12, "bytes_per_sec": 2765e9},
+    "tpu v6 lite": {"flops_per_sec": 918e12, "bytes_per_sec": 1640e9},
+    "tpu v6e": {"flops_per_sec": 918e12, "bytes_per_sec": 1640e9},
+}
+
+
+def peak_for(device_kind: Any) -> dict[str, float] | None:
+    """The peak spec for a ``device_kind`` string, or None for kinds with
+    no honest peak (CPU, unknown accelerators) — callers then report
+    achieved-only."""
+    if not isinstance(device_kind, str) or not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key in sorted(PEAK_SPECS, key=len, reverse=True):
+        if key in kind:
+            return dict(PEAK_SPECS[key])
+    return None

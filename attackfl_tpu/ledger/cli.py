@@ -127,6 +127,28 @@ def format_record(record: dict[str, Any]) -> str:
             f"host-resolution="
             f"{_fmt(record.get('host_resolution_latency'))}s "
             "(depth-k auto-tune inputs)")
+    utilization = record.get("utilization") or {}
+    if utilization:
+        achieved = utilization.get("achieved_flops_per_sec")
+        fraction = utilization.get("utilization_flops")
+        line = (f"  cost: flops/round={_fmt(utilization.get('flops_per_round'))} "
+                f"bytes/round={_fmt(utilization.get('bytes_per_round'))}")
+        if achieved is not None:
+            line += f" achieved={_fmt(achieved)}FLOP/s"
+        if fraction is not None:
+            line += (f" roofline={100 * fraction:.2f}% of "
+                     f"{_fmt(utilization.get('peak_flops_per_sec'))} peak")
+        elif achieved is not None:
+            line += (f" (achieved-only: no peak spec for "
+                     f"{utilization.get('device_kind') or 'this device'})")
+        lines.append(line)
+    programs = record.get("programs") or {}
+    if programs:
+        lines.append(
+            "  programs: " + " ".join(
+                f"{name}[flops={_fmt(p.get('flops'))}]"
+                for name, p in sorted(programs.items())
+                if isinstance(p, dict)))
     compile_info = record.get("compile") or {}
     if compile_info.get("programs") or compile_info.get("cache_hits") \
             is not None:
@@ -197,6 +219,7 @@ def format_compare(diff: dict[str, Any]) -> str:
     render("quality", diff.get("quality") or {}, pct=False)
     render("numerics", diff.get("numerics") or {}, pct=False)
     render("forensics", diff.get("forensics") or {}, pct=False)
+    render("utilization", diff.get("utilization") or {})
     counts = {k: v for k, v in (diff.get("counts") or {}).items()
               if isinstance(v, dict) and v.get("delta")}
     render("counts (changed)", counts, pct=False)

@@ -36,6 +36,12 @@ DEFAULT_THRESHOLDS: dict[str, float] = {
     # forensics: absolute TPR drop / FPR rise that fails
     "tpr_drop": 0.05,
     "fpr_rise": 0.05,
+    # cost observatory (ISSUE 11): relative achieved-FLOP/s drop
+    # (percent) that fails — the roofline column the future scheduler's
+    # bin-packing relies on.  Noise-floored like the rounds/s gate: the
+    # denominator is the same measured device time, so it inherits the
+    # same rep-to-rep wobble.
+    "util_drop_pct": 10.0,
     # cap on how far the noise floor can stretch the perf threshold
     "noise_cap_pct": 30.0,
 }
@@ -142,6 +148,14 @@ def compare_records(old: dict[str, Any],
     for key in sorted(set(old_for) | set(new_for)):
         forensics[key] = _delta(_num(old_for.get(key)), _num(new_for.get(key)))
 
+    utilization = {}
+    old_util = old.get("utilization") or {}
+    new_util = new.get("utilization") or {}
+    for key in sorted(set(old_util) | set(new_util)):
+        delta = _delta(_num(old_util.get(key)), _num(new_util.get(key)))
+        if delta.get("old") is not None or delta.get("new") is not None:
+            utilization[key] = delta
+
     counts = {}
     old_counts = old.get("counts") or {}
     new_counts = new.get("counts") or {}
@@ -163,6 +177,7 @@ def compare_records(old: dict[str, Any],
         "quality": quality,
         "numerics": numerics,
         "forensics": forensics,
+        "utilization": utilization,
         "counts": counts,
     }
 
@@ -250,6 +265,14 @@ def rolling_baseline(records: list[dict[str, Any]],
         for key in {k for r in peers for k in (r.get("forensics") or {})}}
     if not any(v is not None for v in baseline["forensics"].values()):
         baseline["forensics"] = None
+    # roofline columns (ISSUE 11): medians over the numeric utilization
+    # fields (device_kind/basis are identity, not medianable)
+    baseline["utilization"] = {
+        key: median_of(("utilization", key))
+        for key in {k for r in peers for k in (r.get("utilization") or {})
+                    if _num((r.get("utilization") or {}).get(k)) is not None}}
+    if not any(v is not None for v in baseline["utilization"].values()):
+        baseline["utilization"] = None
     baseline["counts"] = {}
     baseline["time_attribution"] = {}
     return baseline
@@ -338,6 +361,28 @@ def regress_check(baseline: dict[str, Any], candidate: dict[str, Any],
                 "baseline": round(old_fpr, 4), "candidate": round(new_fpr, 4),
                 "rise": round(new_fpr - old_fpr, 4),
                 "threshold": th["fpr_rise"]})
+
+    # --- utilization: achieved-FLOP/s drop (ISSUE 11) -----------------
+    # Same noise floor as the rounds/s gate: achieved FLOP/s divides a
+    # STATIC flop count by the measured device time, so its wobble is
+    # exactly the rate wobble — a gate tighter than the noise would cry
+    # wolf on every loaded-box rep.
+    old_util = _num((baseline.get("utilization") or {})
+                    .get("achieved_flops_per_sec"))
+    new_util = _num((candidate.get("utilization") or {})
+                    .get("achieved_flops_per_sec"))
+    util_threshold = max(th["util_drop_pct"], noise_pct)
+    if old_util is not None and new_util is not None and old_util > 0:
+        checks += 1
+        drop_pct = 100.0 * (old_util - new_util) / old_util
+        if drop_pct > util_threshold:
+            violations.append({
+                "check": "utilization:achieved_flops_per_sec",
+                "baseline": round(old_util, 3),
+                "candidate": round(new_util, 3),
+                "drop_pct": round(drop_pct, 2),
+                "threshold_pct": round(util_threshold, 2),
+            })
 
     # --- numerics: non-finite values are never an acceptable delta ----
     old_nf = _num((baseline.get("numerics") or {}).get("nonfinite_total"))

@@ -179,6 +179,8 @@ def derive_record(events: list[dict[str, Any]],
                   source: str = "run") -> dict[str, Any] | None:
     """Distill one run's event slice (+ optional trace spans) into a
     ledger record.  Returns None for an empty slice (nothing ran)."""
+    from attackfl_tpu.costmodel.report import profiles_from_events
+    from attackfl_tpu.costmodel.roofline import utilization_summary
     from attackfl_tpu.telemetry.forensics import forensics_summary
     from attackfl_tpu.telemetry.numerics import numerics_summary
     from attackfl_tpu.telemetry.summary import summarize
@@ -267,6 +269,21 @@ def derive_record(events: list[dict[str, Any]],
                   and e.get("state") == "demoted" for e in events)
     configured = header.get("pipeline_depth_configured")
 
+    # cost observatory (ISSUE 11): the run's program profiles (schema-v9
+    # program_profile events, deduplicated per fingerprint) and the
+    # roofline join — per-round flops/bytes against the MEASURED
+    # round_device_time mined above.  CPU and unknown device kinds carry
+    # achieved-only figures (no peak spec → no utilization fraction).
+    programs = profiles_from_events(events) or None
+    utilization = None
+    if programs:
+        device_kind = next((p["device_kind"] for p in programs.values()
+                            if p.get("device_kind")), "")
+        utilization = utilization_summary(
+            programs,
+            (attribution["device_compute_s"] / rounds) if rounds else None,
+            device_kind)
+
     steady = rates.get("rounds_per_sec_steady")
     record: dict[str, Any] = {
         "ledger_schema": LEDGER_SCHEMA_VERSION,
@@ -308,6 +325,8 @@ def derive_record(events: list[dict[str, Any]],
             round(attribution["host_resolution_s"] / rounds, 6)
             if rounds else None),
         "compile": compile_info,
+        "programs": programs,
+        "utilization": utilization,
         "numerics": numerics_out,
         "forensics": forensics_out,
         "counts": counts,

@@ -55,11 +55,16 @@ def cell_record(
     executor: str = "matrix",
     resumed: bool = False,
     provenance: dict[str, Any] | None = None,
+    programs: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """One cell's ledger record (``ledger_schema`` 1, ``source``
     "matrix").  ``wall_s`` is the SWEEP wall clock: cells share every
     dispatch, so the honest per-cell attribution is the amortized share
-    — recorded as such, never dressed up as a standalone measurement."""
+    — recorded as such, never dressed up as a standalone measurement.
+    ``programs`` (ISSUE 11) is the sweep's program-profile capture — the
+    grid program covers every device cell, so each cell record carries
+    the SHARED profile (flops/bytes/peak memory of the whole grid
+    dispatch), folded into a static ``utilization`` block."""
     cfg = cell_config(base_cfg, cell, rounds=rounds)
     ok_rounds = sum(1 for h in history if h.get("ok"))
     amortized = wall_s / max(n_cells, 1)
@@ -92,6 +97,16 @@ def cell_record(
         },
         "final": _final_quality(history),
     }
+    if programs:
+        from attackfl_tpu.costmodel.roofline import utilization_summary
+
+        record["programs"] = programs
+        device_kind = next((p.get("device_kind") for p in programs.values()
+                            if isinstance(p, dict)
+                            and p.get("device_kind")), "")
+        utilization = utilization_summary(programs, None, device_kind)
+        if utilization is not None:
+            record["utilization"] = utilization
     record.update(provenance or {})
     return record
 
@@ -108,6 +123,7 @@ def sweep_records(
     wall_s: float,
     resumed: bool = False,
     provenance: dict[str, Any] | None = None,
+    programs: dict[str, Any] | None = None,
 ) -> list[dict[str, Any]]:
     """Records for every cell that has a history, in grid order."""
     return [
@@ -115,6 +131,6 @@ def sweep_records(
             sweep_id=sweep_id, cell=cell, base_cfg=base_cfg, rounds=rounds,
             history=histories.get(cell.key) or [], run_id=run_id, ts=ts,
             wall_s=wall_s, n_cells=len(cells), resumed=resumed,
-            provenance=provenance)
+            provenance=provenance, programs=programs)
         for cell in cells if cell.key in histories
     ]

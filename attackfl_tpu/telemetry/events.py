@@ -95,6 +95,19 @@ Schema v8 (ISSUE 10) extends v7 — every v1-v7 file still validates:
   the existing ``degrade`` events (which now carry a ``depth`` field —
   extra fields were always allowed).
 
+Schema v9 (ISSUE 11) extends v8 — every v1-v8 file still validates:
+
+* ``program_profile`` — the cost observatory's capture record
+  (:mod:`attackfl_tpu.costmodel`): one per compiled program, keyed by
+  ``program`` name + config ``fingerprint``, carrying the guarded
+  ``cost_analysis``/``memory_analysis`` snapshot (``flops`` /
+  ``transcendentals`` / ``bytes_accessed`` / ``memory`` byte sizes incl.
+  the derived ``peak``), the ``rounds_per_dispatch`` normalizer (a
+  fused/matrix chunk program covers N rounds per dispatch) and the
+  ``device_kind`` the peak-spec table keys on.  All cost fields are
+  optional — a raising backend analysis degrades to a partial profile,
+  never an absent event.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -111,7 +124,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -167,6 +180,22 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # interrupted/completed) — the whole (attack x defense x seed) grid
     # is one run record
     "matrix": {"sweep_id": str, "action": str},
+    # --- schema v9 kind (ISSUE 11) ---
+    # cost-observatory capture (attackfl_tpu/costmodel): one guarded
+    # cost/memory-analysis snapshot per compiled program, keyed by
+    # program name + config fingerprint.  Every cost field is OPTIONAL
+    # (type-checked below when present): a raising backend analysis
+    # degrades to a partial profile instead of killing the run
+    "program_profile": {"program": str, "fingerprint": str},
+}
+
+# --- schema v9: optional cost payload on `program_profile` events ---
+# (type-checked when present; capture emits whichever halves the backend
+# provided — see costmodel/capture.compiled_profile)
+_OPTIONAL_PROGRAM_PROFILE_FIELDS: dict[str, Any] = {
+    "flops": _NUM, "transcendentals": _NUM, "bytes_accessed": _NUM,
+    "memory": dict, "rounds_per_dispatch": int, "cells": int,
+    "device_kind": str,
 }
 
 # --- schema v3: optional numerics payload on `metric` events ---
@@ -207,6 +236,8 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     # v8 adds no kinds — only the optional run_header pipeline-depth
     # fields (ISSUE 10), like v3's optional metric payload
     8: frozenset(),
+    # + optional cost payload fields on the new kind itself
+    9: frozenset({"program_profile"}),
 }
 
 
@@ -307,6 +338,13 @@ def validate_event(record: Any) -> list[str]:
                                        or not isinstance(record[name], typ)):
                     errors.append(
                         f"[run_header] '{name}' must be {typ.__name__}, got "
+                        f"{type(record[name]).__name__}")
+        if kind == "program_profile":
+            for name, typ in _OPTIONAL_PROGRAM_PROFILE_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[program_profile] '{name}' has type "
                         f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:

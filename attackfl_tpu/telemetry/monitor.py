@@ -14,7 +14,14 @@ grepped logs.  :class:`RunMonitor` runs a stdlib ``http.server`` thread
   ``attackfl-tpu watch`` polls);
 * ``/runs`` — the cross-run ledger's index (ISSUE 7): newest-first
   per-record summaries, so a live monitor also answers "how does this
-  run compare to the last ones".
+  run compare to the last ones";
+* ``/programs`` — the cost observatory (ISSUE 11): every compiled
+  program's captured flops/bytes/peak-memory profile plus a LIVE
+  roofline estimate (per-round flops over the rolling-median round
+  cadence — wall-clock based, so a lower bound on device utilization;
+  the ledger record carries the device-time-based figure).  The same
+  numbers back the ``attackfl_program_flops`` / ``attackfl_utilization``
+  gauges on ``/metrics``.
 
 The **stall watchdog** is a daemon thread that flags the run when no round
 completes within ``stall_factor ×`` the rolling-median round duration
@@ -190,6 +197,10 @@ class RunMonitor:
         # at run start, 0 while demoted, back to k on re-promotion; None
         # on non-pipelined executors (gauge absent rather than 0)
         self._pipeline_depth: int | None = None
+        # cost observatory (ISSUE 11): captured program profiles, set by
+        # the engine at each AOT-compile seam — backs /programs and the
+        # attackfl_program_flops / attackfl_utilization gauges
+        self._cost_programs: dict[str, dict[str, Any]] = {}
         # cross-run ledger (ISSUE 7): /runs lists the store's index so a
         # live monitor also answers "how does this run compare to the
         # last ones" — set by the engine when the ledger is enabled
@@ -216,6 +227,7 @@ class RunMonitor:
         self._server.route("GET", "/metrics", self._route_metrics)
         self._server.route("GET", "/last-round", self._route_last_round)
         self._server.route("GET", "/runs", self._route_runs)
+        self._server.route("GET", "/programs", self._route_programs)
         self._server.start()
         self.port = self._server.port
         threading.Thread(target=self._watchdog_loop,
@@ -276,6 +288,36 @@ class RunMonitor:
         while demoted — demote/re-promote transitions call this)."""
         with self._lock:
             self._pipeline_depth = None if depth is None else int(depth)
+
+    def set_cost_model(self, programs: dict[str, dict[str, Any]]) -> None:
+        """Record the engine's captured program profiles (ISSUE 11) —
+        called at each AOT-compile seam; backs /programs and the cost
+        gauges."""
+        with self._lock:
+            self._cost_programs = dict(programs or {})
+
+    def cost_report(self) -> dict[str, Any]:
+        """``/programs`` payload: the static profiles plus a live
+        roofline estimate over the rolling-median round cadence (a
+        wall-clock denominator — the honest live lower bound; the
+        ledger's figure uses mined device time)."""
+        from attackfl_tpu.costmodel.roofline import utilization_summary
+
+        with self._lock:
+            programs = {name: dict(p)
+                        for name, p in self._cost_programs.items()}
+            durations = list(self._durations)
+        device_kind = next((p.get("device_kind") for p in programs.values()
+                            if p.get("device_kind")), "")
+        median = statistics.median(durations) if durations else None
+        utilization = (utilization_summary(programs, median, device_kind)
+                       if programs else None)
+        if utilization is not None and median is not None:
+            utilization["denominator"] = "round_seconds_median"
+        return {"programs": programs,
+                "device_kind": device_kind,
+                "round_seconds_median": median,
+                "utilization": utilization}
 
     def set_ledger(self, store) -> None:
         """Attach the cross-run ledger store backing ``/runs`` (the store
@@ -439,6 +481,44 @@ class RunMonitor:
                 lines.append(
                     f'attackfl_numerics{{name="{_sanitize(str(name))}"}} '
                     f'{value:.6g}')
+        # cost observatory (ISSUE 11): static per-program profiles + the
+        # live roofline estimate (wall-cadence denominator — see
+        # cost_report)
+        with self._lock:
+            has_programs = bool(self._cost_programs)
+        if has_programs:
+            report = self.cost_report()
+            lines.append("# TYPE attackfl_program_flops gauge")
+            lines.append("# TYPE attackfl_program_bytes gauge")
+            for name, profile in sorted(report["programs"].items()):
+                label = _sanitize(str(name))
+                for gauge, key in (("attackfl_program_flops", "flops"),
+                                   ("attackfl_program_bytes",
+                                    "bytes_accessed")):
+                    value = profile.get(key)
+                    if isinstance(value, (int, float)) \
+                            and not isinstance(value, bool):
+                        lines.append(
+                            f'{gauge}{{program="{label}"}} {value:.6g}')
+            utilization = report.get("utilization") or {}
+            lines.append("# TYPE attackfl_utilization gauge")
+            for kind, key in (("flops", "utilization_flops"),
+                              ("bytes", "utilization_bytes")):
+                value = utilization.get(key)
+                if isinstance(value, (int, float)) \
+                        and not isinstance(value, bool):
+                    lines.append(
+                        f'attackfl_utilization{{kind="{kind}"}} '
+                        f'{value:.6g}')
+            lines.append("# TYPE attackfl_achieved_per_sec gauge")
+            for kind, key in (("flops", "achieved_flops_per_sec"),
+                              ("bytes", "achieved_bytes_per_sec")):
+                value = utilization.get(key)
+                if isinstance(value, (int, float)) \
+                        and not isinstance(value, bool):
+                    lines.append(
+                        f'attackfl_achieved_per_sec{{kind="{kind}"}} '
+                        f'{value:.6g}')
         counters = self._tel.counters.snapshot()
         if counters:
             lines.append("# TYPE attackfl_counter counter")
@@ -463,6 +543,9 @@ class RunMonitor:
 
     def _route_runs(self, query, body):
         return 200, self.runs()
+
+    def _route_programs(self, query, body):
+        return 200, self.cost_report()
 
 
 def _is_plain(value: Any) -> bool:

@@ -292,6 +292,8 @@ def _merge_main(args) -> int:
         return _forensics_main(args, merged)
     if args.numerics:
         return _numerics_main(args, merged)
+    if args.programs:
+        return _programs_main(args, merged)
     skew = merge_mod.skew_summary(merged)
     if args.json:
         print(json.dumps({
@@ -331,6 +333,40 @@ def _numerics_main(args, events: list[dict[str, Any]]) -> int:
                          indent=1))
     else:
         print("\n\n".join(format_numerics(s, rid) for rid, s in reports))
+    return 0
+
+
+def _programs_main(args, events: list[dict[str, Any]]) -> int:
+    """``--programs``: the cost observatory's per-program table (schema
+    v9).  Through ``--merge`` the profiles deduplicate per (run_id,
+    program, fingerprint) — a DCN run reports one profile per program,
+    not one per host (costmodel/report.py)."""
+    from attackfl_tpu.costmodel.report import (
+        format_programs, programs_summary,
+    )
+
+    runs = _select_runs(events, args.run_id, args.all)
+    if not runs:
+        print(f"no events recorded in {args.path!r}", file=sys.stderr)
+        return 2
+    reports = []
+    for run in runs:
+        summary = programs_summary(run)
+        if summary is not None:
+            run_id = next((e.get("run_id") for e in run
+                           if e.get("run_id")), None)
+            reports.append((run_id, summary))
+    if not reports:
+        print("no program_profile events found (telemetry.costmodel off, "
+              "or a pre-v9 artifact)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([dict(s, run_id=rid) for rid, s in reports]
+                         if args.all or len(reports) > 1
+                         else dict(reports[0][1], run_id=reports[0][0]),
+                         indent=1))
+    else:
+        print("\n\n".join(format_programs(s, rid) for rid, s in reports))
     return 0
 
 
@@ -374,7 +410,9 @@ def main(argv: list[str] | None = None) -> int:
                     "round skew; --forensics reports the defense's "
                     "TPR/FPR/precision from attribution events; "
                     "--numerics reports the in-graph device-side round "
-                    "metrics.")
+                    "metrics; --programs reports the cost observatory's "
+                    "per-program flops/bytes/memory profiles and roofline "
+                    "estimate.")
     parser.add_argument("path", nargs="?", default=".",
                         help="events.jsonl or a directory containing it")
     parser.add_argument("--run-id", type=str, default=None,
@@ -394,6 +432,11 @@ def main(argv: list[str] | None = None) -> int:
                              "(update-norm distributions, attack "
                              "separation, drift, non-finite provenance) "
                              "from schema-v3 metric events")
+    parser.add_argument("--programs", action="store_true",
+                        help="per-program cost profiles (flops, bytes "
+                             "accessed, peak scheduled memory) and the "
+                             "roofline utilization estimate from "
+                             "schema-v9 program_profile events")
     args = parser.parse_args(argv)
 
     if args.merge:
@@ -408,6 +451,8 @@ def main(argv: list[str] | None = None) -> int:
         return _forensics_main(args, events)
     if args.numerics:
         return _numerics_main(args, events)
+    if args.programs:
+        return _programs_main(args, events)
     runs = split_runs(events)
     if not runs:
         print(f"no events recorded in {args.path!r}", file=sys.stderr)

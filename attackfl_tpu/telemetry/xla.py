@@ -98,30 +98,14 @@ def enable_compile_cache(cache_dir: str) -> str:
     install_cache_listeners()
     return cache_dir
 
-_BYTE_ATTRS = (
-    ("argument", "argument_size_in_bytes"),
-    ("output", "output_size_in_bytes"),
-    ("temp", "temp_size_in_bytes"),
-    ("alias", "alias_size_in_bytes"),
-    ("generated_code", "generated_code_size_in_bytes"),
-)
-
-
 def memory_analysis_bytes(compiled: Any) -> dict[str, int] | None:
     """Byte sizes from ``compiled.memory_analysis()``, or None when the
-    backend provides none.  Never raises: telemetry must not take a run
-    down because a backend lacks memory stats."""
-    try:
-        analysis = compiled.memory_analysis()
-    except Exception:  # noqa: BLE001 — unimplemented on some backends
-        return None
-    if analysis is None:
-        return None
-    out: dict[str, int] = {}
-    for key, attr in _BYTE_ATTRS:
-        value = getattr(analysis, attr, None)
-        if isinstance(value, bool):
-            continue
-        if isinstance(value, (int, float)):
-            out[key] = int(value)
-    return out or None
+    backend provides none.  Never raises — SHIM over the cost
+    observatory's shared guard (ISSUE 11 factored the duplicated
+    guarded-``memory_analysis`` logic into
+    :func:`attackfl_tpu.costmodel.capture.guarded_memory_analysis`, which
+    also guards ``cost_analysis``); this name is kept for the engine's
+    compile events and existing callers."""
+    from attackfl_tpu.costmodel.capture import guarded_memory_analysis
+
+    return guarded_memory_analysis(compiled)

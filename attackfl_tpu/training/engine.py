@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from attackfl_tpu.config import Config, parse_profile_rounds
+from attackfl_tpu.costmodel.capture import compiled_profile
 from attackfl_tpu.data.partition import dirichlet_label_partition
 from attackfl_tpu.data.synthetic import get_dataset
 from attackfl_tpu.eval.validation import Validation
@@ -275,6 +276,22 @@ class Simulator:
         # donate) (False = AOT failed for this key; fall back to the lazy
         # jit path)
         self._fused_exe_cache: dict[tuple, Any] = {}
+        # cost observatory (ISSUE 11): guarded cost/memory-analysis
+        # snapshots of every compiled program, emitted as schema-v9
+        # `program_profile` events keyed by program name + config
+        # fingerprint.  The fused/pipelined AOT seams profile the
+        # executable they dispatch (free); the synchronous path AOT-
+        # compiles its programs once per run for the snapshot
+        # (_capture_sync_profiles).  Observational only — params are
+        # bit-identical on vs off.  ATTACKFL_COSTMODEL=0 is the harness
+        # kill switch (the tier-1 suite constructs hundreds of
+        # Simulators whose sync-capture compiles would eat the time
+        # budget; production runs keep the config default = on).
+        self._costmodel_on = bool(
+            self.telemetry.enabled and cfg.telemetry.costmodel
+            and os.environ.get("ATTACKFL_COSTMODEL", "1") != "0")
+        self._program_profiles: dict[str, dict[str, Any]] = {}
+        self._sync_profiles_captured = False
 
         # ---- live monitor (health endpoint + stall watchdog) ------------
         # Config-gated; process 0 only — one health endpoint per run, and
@@ -639,6 +656,90 @@ class Simulator:
                 jit=self._pipeline_step_fn(include_eval, donate=True),
                 args=(state,), donate=spec["pipeline_step"]))
         return programs
+
+    # ------------------------------------------------------------------
+    # cost observatory (attackfl_tpu/costmodel — ISSUE 11)
+    # ------------------------------------------------------------------
+
+    def sync_profile_programs(self, state: dict[str, Any] | None = None
+                              ) -> list[tuple[str, Any, tuple]]:
+        """The synchronous path's jitted round programs with example
+        arguments, ``(name, jit_fn, args)`` each — the cost observatory's
+        sync capture set and the ``cost estimate`` CLI's no-peer profiling
+        hook.  Mirrors :meth:`audit_programs`'s argument construction
+        (large operands via ``eval_shape``); nothing is executed."""
+        state = self._canonical_device_state(self._ensure_numerics_state(
+            state if state is not None else self.init_state()))
+        _, k_round, k_agg = jax.random.split(state["rng"], 3)
+        b = jnp.asarray(1)
+        programs: list[tuple[str, Any, tuple]] = []
+        if self.is_hyper:
+            args = (state["hnet_params"], state["prev_genuine"],
+                    state["have_genuine"], jnp.asarray(state["active_mask"]),
+                    k_round, b)
+            stacked, sizes, *_ = jax.eval_shape(self._round_step_raw, *args)
+            programs.append(("round_step", self.round_step, args))
+            programs.append(("hyper_update", self.hyper_update,
+                             (state["hnet_params"], state["hyper_opt_state"],
+                              stacked, jnp.asarray(state["active_mask"]))))
+        else:
+            args = (state["global_params"], state["prev_genuine"],
+                    state["have_genuine"], k_round, b)
+            stacked, sizes, *_ = jax.eval_shape(self._round_step_raw, *args)
+            wmask = jnp.ones((self.cfg.total_clients,), jnp.float32)
+            programs.append(("round_step", self.round_step, args))
+            programs.append(("aggregate", self.aggregate,
+                             (state["global_params"], stacked, sizes, wmask,
+                              k_agg)))
+        return programs
+
+    def _emit_program_profile(self, name: str, compiled: Any,
+                              rounds_per_dispatch: int = 1) -> None:
+        """Snapshot one compiled program's guarded cost/memory analysis
+        as a ``program_profile`` event (schema v9) and feed the live
+        monitor's cost gauges.  A backend with no stats degrades to a
+        partial profile or silence — never an error."""
+        if not self._costmodel_on:
+            return
+        profile = compiled_profile(compiled)
+        if profile is None:
+            return
+        profile["rounds_per_dispatch"] = int(rounds_per_dispatch)
+        profile["device_kind"] = str(jax.devices()[0].device_kind)
+        self._program_profiles[name] = profile
+        self.telemetry.events.emit(
+            "program_profile", program=name,
+            fingerprint=self._ckpt_manager.fingerprint, **profile)
+        if self.monitor is not None:
+            self.monitor.set_cost_model(dict(self._program_profiles))
+
+    def _capture_sync_profiles(self, state: dict[str, Any]) -> None:
+        """AOT-compile the synchronous path's round programs ONCE per
+        Simulator for their cost profiles (the fused/pipelined/matrix
+        executors profile the executable they dispatch, so only the
+        lazy-jit sync path needs this extra compile — a persistent-cache
+        hit when ``compile_cache_dir`` is set).  Compile time is recorded
+        under the usual ``compile`` spans/events, so the ledger's
+        attribution stays honest.  Skipped under a mesh, like the AOT
+        executors (AOT pins shardings)."""
+        if (not self._costmodel_on or self._sync_profiles_captured
+                or self.mesh is not None):
+            return
+        self._sync_profiles_captured = True
+        tel = self.telemetry
+        for name, fn, args in self.sync_profile_programs(state):
+            t0 = time.perf_counter()
+            try:
+                with tel.tracer.span("compile", program=name):
+                    compiled = fn.lower(*args).compile()
+            except Exception as e:  # noqa: BLE001 — capture is best-effort
+                tel.events.emit("compile", program=name,
+                                seconds=round(time.perf_counter() - t0, 6),
+                                error=f"{type(e).__name__}: {e}"[:300])
+                continue
+            tel.events.emit("compile", program=name,
+                            seconds=round(time.perf_counter() - t0, 6))
+            self._emit_program_profile(name, compiled)
 
     # ------------------------------------------------------------------
     # state
@@ -1786,6 +1887,10 @@ class Simulator:
                 if memory:
                     event["memory_bytes"] = memory
                 tel.events.emit("compile", **event)
+                # cost observatory: the chunk program IS `length` rounds
+                # per dispatch — profiled from the executable we dispatch
+                self._emit_program_profile(label, exe,
+                                           rounds_per_dispatch=length)
             self._fused_exe_cache[key] = exe
         return exe
 
@@ -2119,6 +2224,8 @@ class Simulator:
                 if memory:
                     event["memory_bytes"] = memory
                 tel.events.emit("compile", **event)
+                # cost observatory: one round per dispatch
+                self._emit_program_profile(label, exe)
             self._pipeline_exe_cache[key] = exe
         return exe
 
@@ -2422,6 +2529,10 @@ class Simulator:
             print_with_color(
                 f"[pipeline] mode '{cfg.mode}' needs host-side per-round "
                 "work; falling back to the synchronous path.", "yellow")
+        # cost observatory: the sync loop dispatches lazily-jitted
+        # programs, so their profiles need one explicit AOT pass (the
+        # fused/pipelined executors profile at their existing AOT seams)
+        self._capture_sync_profiles(state)
         history: list[dict[str, Any]] = []
         retries = 0
         t_start = time.perf_counter()

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from attackfl_tpu.config import Config
+from attackfl_tpu.costmodel.capture import compiled_profile
 from attackfl_tpu.data.synthetic import get_dataset
 from attackfl_tpu.eval.validation import Validation
 from attackfl_tpu.matrix.grid import (
@@ -208,6 +209,16 @@ class MatrixRun:
         # attribute NAME matches the engine's so the retrace guard
         # (analysis/retrace.jitted_programs) picks the cache up as-is
         self._fused_cache: dict[tuple, Callable] = {}
+        # AOT-compiled chunk executables (engine._fused_executable's
+        # pattern: compile under a telemetry span, profile the executable
+        # we dispatch — the cost observatory's matrix seam, ISSUE 11;
+        # False = AOT failed, fall back to the lazy jit path)
+        self._matrix_exe_cache: dict[tuple, Any] = {}
+        # ATTACKFL_COSTMODEL=0 = the harness kill switch (see engine)
+        self._costmodel_on = bool(
+            self.telemetry.enabled and cfg.telemetry.costmodel
+            and os.environ.get("ATTACKFL_COSTMODEL", "1") != "0")
+        self._program_profiles: dict[str, dict[str, Any]] = {}
 
         # ---- persistence ------------------------------------------------
         # restored sweeps keep donation OFF (jax 0.4.37 latch — see the
@@ -378,6 +389,54 @@ class MatrixRun:
             self.telemetry.counters.inc("round_program_cache_hits")
         return fn
 
+    def _matrix_executable(self, key: tuple, fn: Callable, state) -> Any:
+        """AOT-compile the grid chunk under a telemetry compile span
+        (same contract as the engine's ``_fused_executable``: best-effort,
+        False = permanent fallback to the lazy jit path) and snapshot its
+        cost profile — the executable IS what run() dispatches, so the
+        profile costs no extra compile."""
+        exe = self._matrix_exe_cache.get(key)
+        if exe is None:
+            length = key[0]
+            tel = self.telemetry
+            label = f"matrix_chunk[{length}]"
+            t0 = time.perf_counter()
+            try:
+                with tel.tracer.span("compile", program=label):
+                    exe = fn.lower(state).compile()
+            except Exception as e:  # noqa: BLE001 — AOT is best-effort
+                exe = False
+                tel.events.emit("compile", program=label,
+                                seconds=round(time.perf_counter() - t0, 6),
+                                error=f"{type(e).__name__}: {e}"[:300])
+            else:
+                tel.events.emit(
+                    "compile", program=label,
+                    seconds=round(time.perf_counter() - t0, 6),
+                    scan_length=length)
+                self._emit_program_profile(label, exe,
+                                           rounds_per_dispatch=length)
+            self._matrix_exe_cache[key] = exe
+        return exe
+
+    def _emit_program_profile(self, name: str, compiled: Any,
+                              rounds_per_dispatch: int = 1) -> None:
+        """Schema-v9 ``program_profile`` for the grid program, keyed by
+        the SWEEP fingerprint (the grid program's identity) and carrying
+        the device-cell count — one dispatch covers every cell."""
+        if not self._costmodel_on:
+            return
+        profile = compiled_profile(compiled)
+        if profile is None:
+            return
+        profile["rounds_per_dispatch"] = int(rounds_per_dispatch)
+        profile["cells"] = len(self.device_cells)
+        profile["device_kind"] = str(jax.devices()[0].device_kind)
+        self._program_profiles[name] = profile
+        self.telemetry.events.emit(
+            "program_profile", program=name,
+            fingerprint=self.sweep_fingerprint(), **profile)
+
     # ------------------------------------------------------------------
     # audit hooks (attackfl_tpu/analysis)
     # ------------------------------------------------------------------
@@ -528,10 +587,19 @@ class MatrixRun:
                     n = 1  # retry tails reuse one length-1 program
                 first_dispatch = False
                 donate = self._state_donation_ok
-                includes_compile = (n, donate) not in self._fused_cache
+                includes_compile = (
+                    (n, donate) not in self._fused_cache
+                    and (n, donate) not in self._matrix_exe_cache)
                 t0 = time.perf_counter()
                 with tel.tracer.span("chunk", chunk_len=n, matrix=True):
-                    state, metrics = self._matrix_chunk(n, donate)(state)
+                    fn = self._matrix_chunk(n, donate)
+                    # AOT seam (cost observatory): dispatch the profiled
+                    # executable when telemetry is on, exactly like
+                    # run_fast — the lazy jit path stays the fallback
+                    exe = (self._matrix_executable((n, donate), fn, state)
+                           if tel.enabled else False)
+                    state, metrics = (exe(state) if exe is not False
+                                      else fn(state))
                     # the np.asarray inside _resolve_chunk IS the block:
                     # dispatch is async, so timing must enclose the
                     # materialization (run_fast's lesson)
@@ -694,7 +762,8 @@ class MatrixRun:
                 run_id=self.telemetry.events.run_id,
                 ts=time.time(), wall_s=wall, resumed=self._resumed,
                 provenance={"jax_version": jax.__version__,
-                            "backend": jax.default_backend()})
+                            "backend": jax.default_backend()},
+                programs=dict(self._program_profiles) or None)
             for record in records:
                 self._ledger.append(record)
             self.telemetry.counters.inc("ledger_records_appended",

@@ -82,13 +82,18 @@ def main() -> None:
     ex = (state["global_params"], state["prev_genuine"],
           jnp.asarray(True), k_round, jnp.asarray(1))
     compiled = sim.round_step.lower(*ex).compile()
-    # memory_analysis() may return None or raise on some JAX/backend
-    # versions (ADVICE.md finding 3); the telemetry compile spans share
-    # this guard.  The measured per-client array bytes below are
-    # backend-independent and must survive missing XLA stats.
-    from attackfl_tpu.telemetry.xla import memory_analysis_bytes
+    # cost_analysis()/memory_analysis() may return None or raise on some
+    # JAX/backend versions (ADVICE.md finding 3); the cost observatory
+    # owns the ONE shared guard (costmodel/capture — the telemetry
+    # compile spans go through the same module).  The measured per-client
+    # array bytes below are backend-independent and must survive missing
+    # XLA stats.
+    from attackfl_tpu.costmodel.capture import (
+        guarded_cost_analysis, guarded_memory_analysis,
+    )
 
-    ma = memory_analysis_bytes(compiled)
+    ma = guarded_memory_analysis(compiled)
+    ca = guarded_cost_analysis(compiled)
     compile_s = time.time() - t0
 
     n = cfg.total_clients
@@ -101,6 +106,10 @@ def main() -> None:
         "compile_s": round(compile_s, 1),
         "xla_memory_stats_bytes": ma if ma is not None else {
             "unavailable": "memory_analysis() returned None or raised on "
+                           "this JAX/backend version",
+        },
+        "xla_cost_stats": ca if ca is not None else {
+            "unavailable": "cost_analysis() returned None or raised on "
                            "this JAX/backend version",
         },
         "measured_per_client_bytes": {

@@ -40,4 +40,18 @@ if python -m attackfl_tpu ledger regress auc-drop --against base-r1 \
     exit 1
 fi
 
+echo "--- utilization: identical roofline columns must pass"
+python -m attackfl_tpu ledger regress util-base-r2 --against util-base-r1 \
+    --dir "$CORPUS"
+
+echo "--- utilization: 20% achieved-FLOP/s drop must fail (ISSUE 11 gate)"
+if python -m attackfl_tpu ledger regress util-drop --against util-base-r1 \
+        --dir "$CORPUS"; then
+    echo "regress gate FAILED to flag the utilization drop" >&2
+    exit 1
+fi
+
+echo "--- cost validate: predictor accuracy contract on the corpus"
+python -m attackfl_tpu cost validate --dir "$CORPUS"
+
 echo "ledger regress gate: OK"

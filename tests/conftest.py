@@ -26,6 +26,14 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Cost observatory off by default in the suite: the sync-path capture
+# AOT-compiles round_step/aggregate per distinct config, and tier-1
+# constructs hundreds of Simulators — those extra compiles would eat the
+# suite's time budget for programs no test asserts on.  The costmodel
+# tests (tests/test_costmodel.py) re-enable it per test via monkeypatch;
+# production runs keep the config default (on).
+os.environ.setdefault("ATTACKFL_COSTMODEL", "0")
+
 
 @pytest.fixture(scope="session")
 def rng():

@@ -1,0 +1,475 @@
+"""Cost observatory (ISSUE 11): capture, roofline, prediction, wiring.
+
+Covers the tentpole contract end to end:
+
+* guarded capture degrades to PARTIAL profiles when a backend analysis
+  raises (the factored-helper satellite's regression test);
+* every executor — sync, fused, pipelined, matrix — emits schema-v9
+  ``program_profile`` events and a ledger record with flops/bytes/peak-
+  memory fields, and capture is deterministic (same config fingerprint
+  => byte-equal static profile);
+* params are bit-identical with the observatory on or off;
+* ``cost estimate`` / ``cost validate`` golden behavior against the
+  committed ledger corpus, including the no-peer regression fallback;
+* monitor gauges + /programs, ``metrics --programs``, and the
+  multi-process merge dedup (one profile per program, not per host).
+"""
+
+import json
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from attackfl_tpu.config import AttackSpec, Config
+from attackfl_tpu.costmodel.capture import (
+    compiled_profile, guarded_cost_analysis, guarded_memory_analysis,
+)
+from attackfl_tpu.costmodel.estimate import (
+    fit_regression, predict_device_time, validate_predictions,
+)
+from attackfl_tpu.costmodel.peaks import peak_for
+from attackfl_tpu.costmodel.report import (
+    format_programs, profiles_from_events, programs_summary,
+)
+from attackfl_tpu.costmodel.roofline import (
+    per_round_cost, utilization_summary,
+)
+from attackfl_tpu.ledger.store import LedgerStore
+from attackfl_tpu.training.engine import Simulator
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = str(REPO / "tests" / "data" / "ledger_corpus")
+
+BASE = dict(
+    model="CNNModel", data_name="ICU", num_data_range=(48, 64), epochs=1,
+    batch_size=32, train_size=256, test_size=128,
+)
+
+
+def _cfg(tmp_path, **kw):
+    path = str(tmp_path)
+    kw.setdefault("num_round", 2)
+    return Config(total_clients=4, mode="fedavg",
+                  log_path=path, checkpoint_dir=path, **BASE, **kw)
+
+
+@pytest.fixture()
+def run_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.delenv("ATTACKFL_LEDGER_DIR", raising=False)
+    # the conftest turns the observatory off suite-wide (compile-time
+    # budget); these are the tests that assert on it
+    monkeypatch.setenv("ATTACKFL_COSTMODEL", "1")
+    return tmp_path
+
+
+def _events(tmp_path):
+    with open(tmp_path / "events.jsonl") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _profiles(events):
+    return {e["program"]: e for e in events
+            if e.get("kind") == "program_profile"}
+
+
+# ---------------------------------------------------------------------------
+# guarded capture (the factored-helper satellite)
+# ---------------------------------------------------------------------------
+
+class _Memory:
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 40
+    temp_size_in_bytes = 60
+    alias_size_in_bytes = 0
+    generated_code_size_in_bytes = 7
+
+
+class _FakeCompiled:
+    def __init__(self, cost_raises=False, memory_raises=False):
+        self._cost_raises = cost_raises
+        self._memory_raises = memory_raises
+
+    def cost_analysis(self):
+        if self._cost_raises:
+            raise NotImplementedError("no cost stats on this backend")
+        return [{"flops": 123.0, "transcendentals": 4.0,
+                 "bytes accessed": 456.0, "bytes accessed0{}": 10.0}]
+
+    def memory_analysis(self):
+        if self._memory_raises:
+            raise RuntimeError("no memory stats on this backend")
+        return _Memory()
+
+
+def test_raising_analysis_degrades_to_partial_profile():
+    """A raising cost_analysis must yield the memory half (and vice
+    versa) — never an exception, never a silently absent profile."""
+    full = compiled_profile(_FakeCompiled())
+    assert full["flops"] == 123 and full["bytes_accessed"] == 456
+    assert full["memory"]["peak"] == 200  # arg + out + temp + alias
+
+    no_cost = compiled_profile(_FakeCompiled(cost_raises=True))
+    assert "flops" not in no_cost and no_cost["memory"]["argument"] == 100
+
+    no_memory = compiled_profile(_FakeCompiled(memory_raises=True))
+    assert no_memory["flops"] == 123 and "memory" not in no_memory
+
+    assert compiled_profile(
+        _FakeCompiled(cost_raises=True, memory_raises=True)) is None
+    assert guarded_cost_analysis(object()) is None
+    assert guarded_memory_analysis(object()) is None
+
+
+def test_capture_on_a_real_compiled_program():
+    compiled = jax.jit(lambda x: jax.numpy.sin(x) @ x).lower(
+        jax.numpy.ones((8, 8))).compile()
+    profile = compiled_profile(compiled)
+    assert profile["flops"] > 0
+    assert profile["memory"]["peak"] > 0
+
+
+# ---------------------------------------------------------------------------
+# peaks + roofline arithmetic
+# ---------------------------------------------------------------------------
+
+def test_peak_spec_table():
+    assert peak_for("TPU v4")["flops_per_sec"] == 275e12
+    assert peak_for("TPU v5 lite")["flops_per_sec"] == 197e12
+    # longest-match: v5p must not match the bare v5e/v5-lite entries
+    assert peak_for("TPU v5p")["flops_per_sec"] == 459e12
+    # CPU and unknown kinds: achieved-only by design
+    assert peak_for("cpu") is None
+    assert peak_for("") is None
+    assert peak_for(None) is None
+
+
+def test_per_round_cost_chunk_beats_sum():
+    """A chunked scan profile normalizes by its length and shadows the
+    per-round retry-tail program of the same body (summing would double
+    count); a pure per-round set sums."""
+    chunked = {
+        "fused_scan[16]": {"flops": 1600, "bytes_accessed": 320,
+                           "rounds_per_dispatch": 16},
+        "fused_scan[1]": {"flops": 100, "bytes_accessed": 20,
+                          "rounds_per_dispatch": 1},
+    }
+    cost = per_round_cost(chunked)
+    assert cost["flops_per_round"] == 100.0
+    assert cost["basis"] == ["fused_scan[16]"]
+
+    per_round = {
+        "round_step": {"flops": 90, "bytes_accessed": 15,
+                       "rounds_per_dispatch": 1},
+        "aggregate": {"flops": 10, "bytes_accessed": 5,
+                      "rounds_per_dispatch": 1},
+    }
+    cost = per_round_cost(per_round)
+    assert cost["flops_per_round"] == 100
+    assert cost["bytes_per_round"] == 20
+    assert per_round_cost({}) is None
+
+
+def test_utilization_summary_roofline_and_achieved_only():
+    programs = {"p": {"flops": 2750, "bytes_accessed": 1228,
+                      "rounds_per_dispatch": 1}}
+    util = utilization_summary(programs, 1e-9, "TPU v4")
+    assert util["achieved_flops_per_sec"] == pytest.approx(2.75e12)
+    assert util["utilization_flops"] == pytest.approx(0.01)
+    assert util["utilization_bytes"] == pytest.approx(1.0)
+    # CPU: achieved-only, no peak/utilization keys
+    util = utilization_summary(programs, 1e-9, "cpu")
+    assert util["achieved_flops_per_sec"] == pytest.approx(2.75e12)
+    assert "utilization_flops" not in util
+    # no measured time: static totals only (a crashed run still reports)
+    util = utilization_summary(programs, None, "TPU v4")
+    assert util["flops_per_round"] == 2750
+    assert "achieved_flops_per_sec" not in util
+
+
+# ---------------------------------------------------------------------------
+# capture parity across the four executors
+# ---------------------------------------------------------------------------
+
+def test_profile_capture_parity_all_executors(run_dir, tmp_path):
+    """Every executor profiles the program(s) it dispatches, the events
+    validate, the ledger records carry flops/bytes/peak-memory, and the
+    static profile is a pure function of the config (same fingerprint =>
+    byte-equal profile across Simulators).  The ATTACKFL_TELEMETRY_DIR
+    override routes every run into ONE events.jsonl / ledger, so runs
+    are split by run_id (append order: sync, sync2, fused, pipelined)."""
+    from attackfl_tpu.telemetry.events import validate_event
+    from attackfl_tpu.telemetry.summary import split_runs
+
+    for kwargs, method in ((dict(), "run"), (dict(), "run"),
+                           (dict(num_round=3), "run_fast"),
+                           (dict(pipeline=True), "run")):
+        sim = Simulator(_cfg(tmp_path, **kwargs))
+        getattr(sim, method)(verbose=False)
+        sim.close()
+    runs = split_runs(_events(tmp_path))
+    assert len(runs) == 4
+    sync1, sync2, fused, pipe = (_profiles(run) for run in runs)
+
+    # --- sync: the two per-round programs, full profile fields ---
+    assert set(sync1) == {"round_step", "aggregate"}
+    for event in sync1.values():
+        assert validate_event(event) == []
+        assert event["flops"] > 0 and event["bytes_accessed"] > 0
+        assert event["memory"]["peak"] > 0
+        assert event["rounds_per_dispatch"] == 1
+        assert event["fingerprint"]
+
+    # determinism: same config fingerprint => identical static profile
+    for name in ("round_step", "aggregate"):
+        for key in ("flops", "transcendentals", "bytes_accessed",
+                    "fingerprint"):
+            assert sync2[name].get(key) == sync1[name].get(key), name
+
+    # --- fused: the chunk program, normalized by its scan length ---
+    chunk = next(p for name, p in fused.items()
+                 if name.startswith("fused_scan["))
+    assert chunk["rounds_per_dispatch"] == 3 and chunk["flops"] > 0
+
+    # --- pipelined: the single-round step program ---
+    assert any(name.startswith("pipeline_step[") for name in pipe)
+
+    # --- ledger: every record carries programs + utilization ---
+    records, _ = LedgerStore(str(tmp_path / "ledger")).load()
+    assert len(records) == 4
+    sync_record, _, fused_record, pipe_record = records
+    assert set(sync_record["programs"]) == {"round_step", "aggregate"}
+    assert sync_record["utilization"]["flops_per_round"] > 0
+    assert sync_record["utilization"]["achieved_flops_per_sec"] > 0
+    # CPU backend: achieved-only (no fabricated peak)
+    assert "utilization_flops" not in sync_record["utilization"]
+    assert fused_record["utilization"]["basis"] == [chunk["program"]]
+    assert pipe_record["programs"]
+
+
+def test_matrix_sweep_profiles_grid_program(run_dir, tmp_path):
+    from attackfl_tpu.matrix.grid import GridSpec
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    cfg = _cfg(tmp_path, prng_impl="threefry2x32", partition="iid")
+    grid = GridSpec(
+        attacks=(AttackSpec(mode="LIE", client_ids=(0,), attack_round=1),),
+        defenses=("fedavg", "median"), seeds=(1,), rounds=2, chunk=2)
+    run = MatrixRun(cfg, grid)
+    run.run(verbose=False)
+    run.close()
+    profiles = _profiles(_events(tmp_path))
+    chunk = next((p for name, p in profiles.items()
+                  if name.startswith("matrix_chunk[")), None)
+    assert chunk is not None
+    assert chunk["cells"] == 2 and chunk["rounds_per_dispatch"] == 2
+    assert chunk["fingerprint"].startswith("matrix-")
+    # every cell record carries the shared grid profile + static totals
+    records, _ = LedgerStore(str(tmp_path / "ledger")).load()
+    cells = [r for r in records if r.get("source") == "matrix"]
+    assert cells
+    for record in cells:
+        assert chunk["program"] in record["programs"]
+        assert record["utilization"]["flops_per_round"] > 0
+
+
+def test_params_bit_identical_costmodel_on_off(run_dir, tmp_path):
+    import dataclasses
+
+    from attackfl_tpu.ops import pytree as pt
+
+    finals = []
+    for on in (True, False):
+        cfg = _cfg(tmp_path / ("on" if on else "off"))
+        cfg = cfg.replace(telemetry=dataclasses.replace(
+            cfg.telemetry, costmodel=on))
+        sim = Simulator(cfg)
+        state, _ = sim.run(verbose=False)
+        sim.close()
+        finals.append(jax.tree.leaves(state["global_params"]))
+    for a, b in zip(*finals):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# estimate / validate (golden, against the committed corpus)
+# ---------------------------------------------------------------------------
+
+def _corpus_records():
+    records, skipped = LedgerStore(CORPUS).load()
+    assert skipped == 0
+    return records
+
+
+def test_estimate_peer_path_golden():
+    records = _corpus_records()
+    prediction = predict_device_time(records, "5caa55e38b3a9da0")
+    assert prediction is not None
+    predicted, info = prediction
+    assert info["method"] == "peer"
+    # median over base-r1/r2/slow-20pct/auc-drop device times
+    assert predicted == pytest.approx(1.483, rel=0.01)
+
+
+def test_estimate_no_peer_regression_fallback_golden():
+    """A NEW fingerprint with a static profile must route through the
+    flops/bytes regression over non-peer records (the committed corpus's
+    utilization trio feeds the fit)."""
+    records = _corpus_records()
+    assert predict_device_time(records, "no-such-fingerprint") is None
+    profile = {"flops_per_round": 1.0e12, "bytes_per_round": 1.6e11}
+    prediction = predict_device_time(records, "no-such-fingerprint",
+                                     profile=profile)
+    assert prediction is not None
+    predicted, info = prediction
+    assert info["method"] in ("regression", "flops_ratio")
+    # half the util-pair's flops/bytes => roughly half its device time,
+    # generously bounded (the fit pools heterogeneous records)
+    assert 0.05 < predicted < 2.0
+
+    fit = fit_regression(records, exclude_fingerprint="no-such-fingerprint")
+    assert fit is not None and fit["n"] >= 3
+
+
+def test_validate_corpus_meets_accuracy_contract():
+    """The ISSUE 11 acceptance bar: median predicted-vs-measured device-
+    time error <= 2x on the committed corpus."""
+    report = validate_predictions(_corpus_records())
+    assert report["predicted"] >= 7
+    assert report["median_error_factor"] is not None
+    assert report["median_error_factor"] <= 2.0
+
+
+def test_cost_cli_validate_and_estimate_exit_codes(tmp_path, capsys):
+    from attackfl_tpu.costmodel.cli import main as cost_main
+
+    assert cost_main(["validate", "--dir", CORPUS]) == 0
+    out = capsys.readouterr().out
+    assert "median=" in out and "PASS" in out
+    # an impossible bound must flip the gate
+    assert cost_main(["validate", "--dir", CORPUS,
+                      "--max-median-factor", "1.0"]) == 1
+    # empty ledger: nothing to validate
+    assert cost_main(["validate", "--dir", str(tmp_path / "empty")]) == 2
+
+
+def test_cost_cli_estimate_no_peer_no_compile(tmp_path, capsys):
+    from attackfl_tpu.costmodel.cli import main as cost_main
+
+    config = tmp_path / "config.yaml"
+    config.write_text("server:\n  num-round: 3\n")
+    rc = cost_main(["estimate", "--config", str(config),
+                    "--dir", str(tmp_path / "empty"), "--no-compile"])
+    assert rc == 2
+    assert "unpredictable" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# regress gate: achieved-FLOP/s drop
+# ---------------------------------------------------------------------------
+
+def test_utilization_regress_gate_bites_and_respects_noise():
+    from attackfl_tpu.ledger.compare import regress_check
+
+    store = LedgerStore(CORPUS)
+    verdict = regress_check(store.get("util-base-r1"),
+                            store.get("util-drop"))
+    checks = {v["check"] for v in verdict["violations"]}
+    assert "utilization:achieved_flops_per_sec" in checks
+    # the synthetic pair holds steady r/s constant: ONLY the roofline
+    # column trips, proving the new gate (not the old one) bit
+    assert "rounds_per_sec" not in checks
+    # identical pair passes
+    assert regress_check(store.get("util-base-r1"),
+                         store.get("util-base-r2"))["ok"]
+    # rolling baselines median the utilization columns
+    from attackfl_tpu.ledger.compare import rolling_baseline
+
+    records, _ = store.load()
+    candidate = store.get("util-drop")
+    baseline = rolling_baseline(records, candidate)
+    assert baseline is not None
+    assert baseline["utilization"]["achieved_flops_per_sec"] \
+        == pytest.approx(3.984e12, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# reporting: monitor, metrics --programs, merge dedup
+# ---------------------------------------------------------------------------
+
+class _FakeCounters:
+    def snapshot(self):
+        return {}
+
+    def inc(self, *a, **k):
+        pass
+
+
+class _FakeTelemetry:
+    def __init__(self):
+        self.counters = _FakeCounters()
+
+        class _E:
+            def emit(self, *a, **k):
+                return {}
+
+            def flush(self):
+                pass
+
+        self.events = _E()
+
+
+def test_monitor_cost_gauges_and_programs_endpoint():
+    from attackfl_tpu.telemetry.monitor import RunMonitor
+
+    monitor = RunMonitor(_FakeTelemetry(), port=0)
+    monitor.set_cost_model({
+        "fused_scan[8]": {"flops": 8e9, "bytes_accessed": 8e8,
+                          "rounds_per_dispatch": 8,
+                          "device_kind": "TPU v4",
+                          "memory": {"peak": 1000}}})
+    monitor.record_round({"round": 1, "ok": True, "seconds": 0.5})
+    text = monitor.metrics_text()
+    assert 'attackfl_program_flops{program="fused_scan_8_"} 8e+09' in text
+    assert "attackfl_utilization" in text
+    report = monitor.cost_report()
+    assert report["device_kind"] == "TPU v4"
+    assert report["utilization"]["flops_per_round"] == pytest.approx(1e9)
+    # live estimate over the round cadence: 1e9 flops / 0.5 s / 275e12
+    assert report["utilization"]["utilization_flops"] == pytest.approx(
+        1e9 / 0.5 / 275e12, rel=0.01)
+    assert report["utilization"]["denominator"] == "round_seconds_median"
+
+
+def test_metrics_programs_cli_on_committed_v9_corpus(capsys):
+    from attackfl_tpu.telemetry.summary import main as metrics_main
+
+    path = str(REPO / "tests" / "data" / "events.v9.jsonl")
+    assert metrics_main(["--programs", path]) == 0
+    out = capsys.readouterr().out
+    assert "round_step" in out and "aggregate" in out
+    assert "flops/round=" in out
+    # and --json round-trips
+    assert metrics_main(["--programs", "--json", path]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["programs"]["round_step"]["flops"] > 0
+
+
+def test_merge_dedups_profiles_per_fingerprint():
+    """Two processes profiling the same program (a DCN run) must report
+    ONE profile, not one per host — the numerics broadcast-dedup
+    discipline applied to program_profile events."""
+    base = {"kind": "program_profile", "schema": 9, "ts": 1.0,
+            "run_id": "r1", "program": "round_step",
+            "fingerprint": "f1", "flops": 100, "rounds_per_dispatch": 1}
+    events = [dict(base, process_index=0), dict(base, process_index=1),
+              dict(base, program="aggregate", flops=7, process_index=0),
+              dict(base, program="aggregate", flops=7, process_index=1)]
+    programs = profiles_from_events(events)
+    assert set(programs) == {"round_step", "aggregate"}
+    assert programs["round_step"]["flops"] == 100
+    summary = programs_summary(events)
+    assert set(summary["programs"]) == {"round_step", "aggregate"}
+    assert "round_step" in format_programs(summary)

@@ -29,6 +29,7 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/multihost/events.0.jsonl" in names
     assert "tests/data/multihost/events.1.jsonl" in names
     assert "tests/data/events.v3.jsonl" in names
+    assert "tests/data/events.v9.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 
@@ -56,3 +57,24 @@ def test_v3_numerics_artifact_validates_standalone():
                and isinstance(e["round"], int) for e in rows)
     # null gauges (non-finite on device) are part of the v3 contract
     assert any(v is None for e in rows for v in e["numerics"].values())
+
+
+def test_v9_costmodel_artifact_validates_standalone():
+    """The committed v9 corpus (ISSUE 11): `program_profile` events from
+    a real run validate and actually exercise the cost payload (flops,
+    bytes accessed, peak memory, per-dispatch normalizer)."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v9.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    rows = [e for e in events if e["kind"] == "program_profile"]
+    assert rows, "v9 corpus must contain program_profile events"
+    for event in rows:
+        assert event["fingerprint"]
+        assert event["flops"] > 0
+        assert event["bytes_accessed"] > 0
+        assert event["memory"]["peak"] > 0
+        assert event["rounds_per_dispatch"] >= 1
+        assert isinstance(event["device_kind"], str)

@@ -341,8 +341,11 @@ def test_import_committed_bench_artifacts(tmp_path, capsys):
     pipe = by_variant[("fl_pipeline_vs_sync_rounds_per_sec",
                        "pipelined_async_ckpt")]
     assert pipe["executor"] == "pipelined"
-    assert pipe["rounds_per_sec_steady"] == 3.5984
-    assert pipe["per_rep"] == [2.979, 3.3829, 3.5984]
+    # the ISSUE 11 refresh: PR 10 recorded that the historical 3.60 r/s
+    # depth-1 figure no longer reproduces post-PR-6 — these are the
+    # re-measured honest numbers
+    assert pipe["rounds_per_sec_steady"] == 3.3117
+    assert pipe["per_rep"] == [2.9684, 2.8106, 3.3117]
     warm = by_variant[("fl_compile_cache_warm_vs_cold_s", "warm_cache")]
     assert warm["compile"]["cache_hits"] == 116
 

@@ -245,11 +245,15 @@ def test_matrix_ledger_records_share_sweep_id(tmp_path, monkeypatch):
     final, _ = runner.run(verbose=False, save_checkpoints=False)
 
     # retrace guard: the sweep's program is warm — another chunk over a
-    # fresh grid state must add ZERO jit-cache entries
+    # fresh grid state must add ZERO jit-cache entries.  Dispatch the
+    # way run() does (the cost observatory's AOT executable when cached,
+    # ISSUE 11 — the lazy jit fn is only the fallback)
     guard = RetraceGuard(runner)
     guard.snapshot()
-    runner._matrix_chunk(2, donate=True)(
-        runner._ensure_numerics(runner.init_state()))
+    state = runner._ensure_numerics(runner.init_state())
+    fn = runner._matrix_chunk(2, donate=True)
+    exe = runner._matrix_executable((2, True), fn, state)
+    (exe if exe is not False else fn)(state)
     assert guard.violations() == []
     runner.close()
 
