@@ -76,6 +76,12 @@ MATRIX_DIR = REPO / "attackfl_tpu" / "matrix"
 # ever materialize a device value (NO allowlist by design; profiling a
 # program is lower+compile, not dispatch)
 COSTMODEL_DIR = REPO / "attackfl_tpu" / "costmodel"
+# the shard_map execution layer (ISSUE 12): mapped bodies + collective
+# aggregation are traced-only (NO allowlist by design — a collective is
+# device-device, never device-host; mesh.py itself is host-side
+# placement plumbing and stays outside this lint, like the engine's
+# non-hot-path modules)
+PARALLEL_FILES = (REPO / "attackfl_tpu" / "parallel" / "shard.py",)
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -258,7 +264,8 @@ def host_sync_files() -> list[Path]:
     return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
             + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py"))
             + sorted(MATRIX_DIR.glob("*.py"))
-            + sorted(COSTMODEL_DIR.glob("*.py")))
+            + sorted(COSTMODEL_DIR.glob("*.py"))
+            + list(PARALLEL_FILES))
 
 
 @register(

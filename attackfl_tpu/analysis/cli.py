@@ -27,7 +27,8 @@ REPORT_SCHEMA = 1
 
 
 def build_report(skip_programs: bool = False, retrace: bool = False,
-                 rule_ids: list[str] | None = None) -> dict[str, Any]:
+                 rule_ids: list[str] | None = None,
+                 skip_sharded: bool = False) -> dict[str, Any]:
     """Run the selected passes and assemble the audit report."""
     ctx = AuditContext()
     findings: list[Finding] = run_rules(ctx, rule_ids)
@@ -38,6 +39,16 @@ def build_report(skip_programs: bool = False, retrace: bool = False,
 
         reports = (program_audit.audit_default_programs()
                    + program_audit.audit_matrix_program())
+        if not skip_sharded:
+            # mesh-native executors (ISSUE 12): sharded fused/pipelined/
+            # sync programs against the per-defense collective
+            # expectation table, and the cell-sharded matrix program
+            # (collective-free by design).  --skip-sharded exists for
+            # time-budgeted harnesses: the donation check compiles the
+            # sharded programs (aliasing is resolved at compile time
+            # under a mesh), which costs minutes on a small CPU box.
+            reports += (program_audit.audit_sharded_programs()
+                        + program_audit.audit_sharded_matrix_program())
         programs = [r.to_dict() for r in reports]
         findings.extend(program_audit.reports_to_findings(reports))
         budget = program_audit.transfer_budget()
@@ -63,11 +74,13 @@ def format_report(report: dict[str, Any]) -> str:
         lines.append(Finding(**f).format())
     for p in report["programs"]:
         status = "OK" if p["ok"] else "FAIL"
+        collectives = p.get("collectives") or []
         lines.append(
             f"program {p['name']} [{p['executor']}]: {status} — "
             f"{p['eqns']} eqns, donated {p['donated_leaves']} leaf(s), "
             f"aliased {p['aliased_leaves']}/{p['expected_aliases']} "
             f"expected, forbidden={p['forbidden_primitives'] or 'none'}, "
+            f"collectives={','.join(collectives) or 'none'}, "
             f"f64={p['f64_outputs']}")
     budget = report.get("transfer_budget") or {}
     if budget:
@@ -95,7 +108,12 @@ def audit_main(argv: list[str] | None = None) -> int:
                              "program tracing — fast)")
     parser.add_argument("--retrace", action="store_true",
                         help="also run the dynamic retrace guard "
-                             "(EXECUTES a few CPU rounds per executor)")
+                             "(EXECUTES a few CPU rounds per executor, "
+                             "sharded runs across mesh sizes included)")
+    parser.add_argument("--skip-sharded", action="store_true",
+                        help="skip the mesh-native (shard_map) program "
+                             "audits — their donation check COMPILES the "
+                             "sharded programs (minutes on a small box)")
     parser.add_argument("--rules", nargs="*", default=None, metavar="RULE",
                         help="run only these rule ids (default: all)")
     parser.add_argument("--list-rules", action="store_true",
@@ -107,7 +125,8 @@ def audit_main(argv: list[str] | None = None) -> int:
             print(f"{rule['id']}: {rule['description']}")
         return 0
     report = build_report(skip_programs=args.skip_programs,
-                          retrace=args.retrace, rule_ids=args.rules)
+                          retrace=args.retrace, rule_ids=args.rules,
+                          skip_sharded=args.skip_sharded)
     if args.json:
         print(json.dumps(report, indent=2))
     else:

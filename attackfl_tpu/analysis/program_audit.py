@@ -51,6 +51,34 @@ from attackfl_tpu.analysis.findings import Finding
 FORBIDDEN_PRIMITIVES = frozenset({"infeed", "outfeed"})
 FORBIDDEN_SUBSTRINGS = ("callback",)
 
+# Cross-device collective primitives (ISSUE 12): what a shard_map'd round
+# program may legitimately contain.  The per-defense expectation table
+# below is asserted against the traced program — a defense growing an
+# unexpected collective (or losing its required one) fails the audit.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "all_gather", "all_to_all", "ppermute", "pmin", "pmax",
+    "reduce_scatter", "pbroadcast", "psum_invariant",
+})
+
+# defense mode -> the exact collective set its sharded aggregation chain
+# may use (parallel/shard.shard_aggregator's design table): partial-sum
+# defenses reduce with psum only; order-statistic/pairwise/quantile/
+# anchor defenses reassemble the full client matrix with all_gather and
+# nothing else.  Training itself (shard_local_update) is collective-free
+# by construction, so these sets describe the WHOLE round program.
+EXPECTED_COLLECTIVES: dict[str, frozenset[str]] = {
+    "fedavg": frozenset({"psum"}),
+    "fltracer": frozenset({"psum"}),
+    "gmm": frozenset({"psum"}),
+    "shieldfl": frozenset({"psum"}),
+    "FLTrust": frozenset({"psum"}),
+    "median": frozenset({"all_gather"}),
+    "trimmed_mean": frozenset({"all_gather"}),
+    "krum": frozenset({"all_gather"}),
+    "scionfl": frozenset({"all_gather"}),
+    "byzantine": frozenset({"all_gather"}),
+}
+
 FORBIDDEN_HINT = (
     "host work must live in the engine's audited resolve points (see the "
     "host-sync rule), never inside a jitted round program")
@@ -95,6 +123,13 @@ def forbidden_primitives(counts: Counter) -> list[str]:
                 s in name for s in FORBIDDEN_SUBSTRINGS):
             bad.append(name)
     return sorted(bad)
+
+
+def collective_primitives(counts: Counter) -> list[str]:
+    """Cross-device collectives present in the program (sorted).  An
+    unsharded program must report none; a sharded one exactly its
+    defense's expectation-table entry."""
+    return sorted(name for name in counts if name in COLLECTIVE_PRIMITIVES)
 
 
 def wide_dtype_outputs(jaxpr) -> int:
@@ -150,6 +185,8 @@ class ProgramReport:
     expected_aliases: int
     aliased_leaves: int
     f64_outputs: int
+    collectives: list[str] = field(default_factory=list)
+    expected_collectives: list[str] = field(default_factory=list)
     problems: list[str] = field(default_factory=list)
 
     @property
@@ -167,19 +204,31 @@ class ProgramReport:
             "expected_aliases": self.expected_aliases,
             "aliased_leaves": self.aliased_leaves,
             "f64_outputs": self.f64_outputs,
+            "collectives": list(self.collectives),
+            "expected_collectives": list(self.expected_collectives),
             "problems": self.problems,
         }
 
 
 def audit_program(name: str, executor: str, raw, jit_fn, args: tuple,
-                  donate: tuple[int, ...]) -> ProgramReport:
+                  donate: tuple[int, ...],
+                  expected_collectives: frozenset[str] = frozenset(),
+                  ) -> ProgramReport:
     """Audit one program: jaxpr invariants from ``raw``, donation aliasing
-    from lowering ``jit_fn``.  Pure analysis — nothing executes."""
+    from lowering ``jit_fn``.  Pure analysis — nothing executes.
+
+    ``expected_collectives`` is the exact cross-device collective set the
+    program may contain: empty (the default) for single-device programs,
+    the :data:`EXPECTED_COLLECTIVES` entry for a sharded defense chain.
+    Any deviation — an extra collective OR a missing required one — is a
+    problem (a lost psum means the sharded aggregate silently went
+    device-local)."""
     import jax
 
     jaxpr = jax.make_jaxpr(raw)(*args)
     counts = walk_jaxpr(jaxpr)
     forbidden = forbidden_primitives(counts)
+    collectives = collective_primitives(counts)
     f64 = wide_dtype_outputs(jaxpr)
 
     donated_leaves = [leaf for i in donate
@@ -188,7 +237,22 @@ def audit_program(name: str, executor: str, raw, jit_fn, args: tuple,
     expected = expected_alias_count(donated_leaves, outputs)
     # the lowered StableHLO carries one tf.aliasing_output attribute per
     # input buffer jax actually donated AND found an aliasable output for
-    aliased = jit_fn.lower(*args).as_text().count("tf.aliasing_output")
+    lowered = jit_fn.lower(*args)
+    aliased = lowered.as_text().count("tf.aliasing_output")
+    if aliased != expected and expected > 0:
+        # Sharded programs (ISSUE 12): jax defers donation aliasing to
+        # COMPILE time when the program carries mesh shardings — the
+        # StableHLO has no tf.aliasing_output attributes, yet the
+        # compiled module's input_output_alias header holds the full
+        # alias map (verified: donation survives shard_map).  Read it
+        # from the executable instead; entries look like
+        # ``{0}: (0, {}, may-alias)`` on the HloModule header line.
+        try:
+            header = lowered.compile().as_text().split("\n", 1)[0]
+        except Exception:  # noqa: BLE001 — fall back to the lowered count
+            header = ""
+        if "input_output_alias" in header:
+            aliased = header.count("-alias)")
 
     report = ProgramReport(
         name=name, executor=executor,
@@ -196,11 +260,19 @@ def audit_program(name: str, executor: str, raw, jit_fn, args: tuple,
         forbidden=forbidden, donated_args=tuple(donate),
         donated_leaves=len(donated_leaves), expected_aliases=expected,
         aliased_leaves=aliased, f64_outputs=f64,
+        collectives=collectives,
+        expected_collectives=sorted(expected_collectives),
     )
     if forbidden:
         report.problems.append(
             f"forbidden host-transfer primitive(s) in a sync-free program: "
             f"{', '.join(forbidden)}")
+    if set(collectives) != set(expected_collectives):
+        report.problems.append(
+            f"collective set mismatch: program contains "
+            f"[{', '.join(collectives) or 'none'}], expected "
+            f"[{', '.join(sorted(expected_collectives)) or 'none'}] "
+            "(see EXPECTED_COLLECTIVES / parallel/shard's design table)")
     if aliased != expected:
         report.problems.append(
             f"donation aliasing mismatch: {aliased} aliased buffer(s) in "
@@ -241,6 +313,82 @@ def audit_default_programs(modes: tuple[str, ...] = ("fedavg",)
         finally:
             sim.close()
     return reports
+
+
+def audit_sharded_programs(modes: tuple[str, ...] = ("fedavg", "median",
+                                                     "FLTrust"),
+                           ) -> list[ProgramReport]:
+    """Audit the mesh-native (shard_map) executors (ISSUE 12): for each
+    defense mode, build a Simulator over a 1-D mesh spanning every
+    visible device (threefry keys — the shard_map gate) and audit the
+    sync round/aggregate pair, the fused chunk and the pipelined step
+    against the SAME invariants as the single-device programs PLUS the
+    per-defense collective expectation table: zero callbacks, donation
+    aliasing surviving shard_map unchanged, and exactly the collectives
+    :data:`EXPECTED_COLLECTIVES` allows.  Device-count agnostic — on one
+    device the mesh has size 1 and the collectives still appear in the
+    jaxpr (the invariants are structural)."""
+    import jax
+
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    ndev = len(jax.devices())
+    reports: list[ProgramReport] = []
+    for mode in modes:
+        expected = EXPECTED_COLLECTIVES[mode]
+        cfg = audit_config(mode=mode, prng_impl="threefry2x32",
+                           total_clients=2 * ndev)
+        sim = Simulator(cfg, use_mesh=True)
+        try:
+            assert sim.mesh_strategy == "shard_map", sim.mesh_strategy
+            for p in sim.audit_programs():
+                report = audit_program(
+                    p["name"], p["executor"], p["raw"], p["jit"],
+                    p["args"], p["donate"],
+                    # round_step alone carries the collective-free
+                    # shard_map'd trainer; every program containing the
+                    # aggregation chain carries the defense's set
+                    expected_collectives=(frozenset()
+                                          if p["name"] == "round_step"
+                                          else expected))
+                report.name = f"sharded-{mode}[{ndev}dev]:{report.name}"
+                reports.append(report)
+        finally:
+            sim.close()
+    return reports
+
+
+def audit_sharded_matrix_program() -> list[ProgramReport]:
+    """Audit the CELL-sharded scenario-matrix program (ISSUE 12): the
+    cell axis is embarrassingly parallel, so the partitioned grid body
+    must contain NO collectives at all — the placement is pure GSPMD
+    constraints, and any collective appearing means cells started
+    communicating."""
+    import jax
+
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.matrix.grid import grid_from_dict
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    cfg = audit_config(prng_impl="threefry2x32")
+    grid = grid_from_dict({
+        "attacks": ["LIE"], "attack-clients": 1, "attack-round": 2,
+        "defenses": ["fedavg", "krum", "FLTrust"], "seeds": [1],
+        "rounds": 2,
+    })
+    runner = MatrixRun(cfg, grid, use_mesh=True)
+    ndev = len(jax.devices())
+    try:
+        reports = []
+        for p in runner.audit_programs():
+            report = audit_program(p["name"], p["executor"], p["raw"],
+                                   p["jit"], p["args"], p["donate"])
+            report.name = f"sharded[{ndev}dev]:{report.name}"
+            reports.append(report)
+        return reports
+    finally:
+        runner.close()
 
 
 def audit_matrix_program() -> list[ProgramReport]:

@@ -130,4 +130,39 @@ def guard_findings(modes_and_executors=(("fedavg", False),
                     line=0, message=problem, hint=RETRACE_GUARD_HINT))
         finally:
             sim.close()
+    findings.extend(sharded_guard_findings())
+    return findings
+
+
+def sharded_guard_findings() -> list[Finding]:
+    """Retrace guard over the mesh-native executors ACROSS MESH SIZES
+    (ISSUE 12): the shard_map'd sync and pipelined programs at a 1-device
+    mesh and at the full visible mesh must each compile once and never
+    again — mesh size is program structure (it changes shard shapes), so
+    each size legitimately compiles its own program, but rounds within
+    one size must never retrace."""
+    import jax
+
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.parallel.mesh import make_client_mesh
+    from attackfl_tpu.training.engine import Simulator
+
+    ndev = len(jax.devices())
+    sizes = sorted({1, ndev})
+    findings = []
+    for size in sizes:
+        for pipeline in (False, True):
+            cfg = audit_config(mode="fedavg", prng_impl="threefry2x32",
+                               total_clients=2 * ndev)
+            sim = Simulator(cfg, mesh=make_client_mesh(size))
+            try:
+                label = ("pipelined" if pipeline else "sync")
+                for problem in run_with_guard(sim, num_rounds=3,
+                                              pipeline=pipeline):
+                    findings.append(Finding(
+                        rule="retrace-guard",
+                        file=f"<run:sharded[{size}dev]:{label}>",
+                        line=0, message=problem, hint=RETRACE_GUARD_HINT))
+            finally:
+                sim.close()
     return findings

@@ -440,6 +440,15 @@ def watch_main(argv=None) -> int:
                         + "]")
             if isinstance(depth, int):
                 msg += f" depth={depth}"
+            mesh = last.get("mesh_devices")
+            if isinstance(mesh, int):
+                # mesh shape on the round line (ISSUE 12): strategy
+                # suffixed when the monitor knows it (sm = shard_map
+                # collectives, gspmd = partitioned single program)
+                strategy = last.get("mesh_strategy")
+                msg += f" mesh={mesh}" + (
+                    "sm" if strategy == "shard_map"
+                    else ("g" if strategy == "gspmd" else ""))
             fraction = utilization.get("utilization_flops")
             achieved = utilization.get("achieved_flops_per_sec")
             if isinstance(fraction, (int, float)):

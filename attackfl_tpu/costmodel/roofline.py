@@ -80,17 +80,32 @@ def per_round_cost(programs: dict[str, dict[str, Any]]
 
 def utilization_summary(programs: dict[str, dict[str, Any]],
                         round_device_time: Any,
-                        device_kind: Any) -> dict[str, Any] | None:
+                        device_kind: Any,
+                        mesh_devices: Any = None) -> dict[str, Any] | None:
     """Achieved FLOP/s + bytes/s (and, with a known peak, utilization
     fractions) for one run.  ``round_device_time`` is the ledger's
     measured device seconds per round; None/0 yields the static
     per-round totals with no rates (a crashed run still reports what it
-    compiled)."""
+    compiled).
+
+    ``mesh_devices`` (ISSUE 12): on an N-device slice the per-round
+    totals are the WHOLE program's work, so the roofline denominator is
+    N single-chip peaks — utilization is ``achieved / (N · peak)``.
+    Without it a perfectly-scaled 8-chip run would report 8x a chip's
+    ceiling.  ``achieved_*_per_sec`` stays the whole-slice rate (the
+    scaling-curve quantity); the fraction is what normalizes per chip.
+    None/0/1 keeps the single-device math byte-for-byte."""
     cost = per_round_cost(programs)
     if cost is None:
         return None
     out: dict[str, Any] = dict(cost)
     out["device_kind"] = device_kind if isinstance(device_kind, str) else ""
+    devices = mesh_devices
+    if isinstance(devices, bool) or not isinstance(devices, int) \
+            or devices < 2:
+        devices = 1
+    if devices > 1:
+        out["mesh_devices"] = devices
     seconds = round_device_time
     if isinstance(seconds, bool) or not isinstance(seconds, (int, float)) \
             or seconds <= 0:
@@ -108,12 +123,12 @@ def utilization_summary(programs: dict[str, dict[str, Any]],
                 # 12 decimals: toy CPU programs land at ~1e-6 of a TPU
                 # peak — 6 decimals would round a real fraction to zero
                 out["utilization_flops"] = round(
-                    achieved / peak["flops_per_sec"], 12)
+                    achieved / (devices * peak["flops_per_sec"]), 12)
         size = cost.get("bytes_per_round")
         if size is not None:
             achieved = size / seconds
             out["achieved_bytes_per_sec"] = round(achieved, 3)
             if peak is not None and peak["bytes_per_sec"] > 0:
                 out["utilization_bytes"] = round(
-                    achieved / peak["bytes_per_sec"], 12)
+                    achieved / (devices * peak["bytes_per_sec"]), 12)
     return out

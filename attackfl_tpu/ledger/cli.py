@@ -58,8 +58,19 @@ def _fmt_depth(entry: dict[str, Any]) -> str:
     return str(depth)
 
 
+def _fmt_mesh(entry: dict[str, Any]) -> str:
+    """`ledger list` mesh column: device count of the run's mesh (``-``
+    for meshless runs and records predating the field)."""
+    devices = entry.get("mesh_devices")
+    if not isinstance(devices, int) or isinstance(devices, bool) \
+            or devices < 1:
+        return "-"
+    return str(devices)
+
+
 def format_list(entries: list[dict[str, Any]]) -> str:
-    lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'depth':<7}{'src':<7}"
+    lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'depth':<7}{'mesh':<6}"
+             f"{'src':<7}"
              f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"]
     for entry in entries:
         workload = "-"
@@ -79,6 +90,7 @@ def format_list(entries: list[dict[str, Any]]) -> str:
             f"{_fmt_ts(entry.get('ts')):<18}"
             f"{str(entry.get('executor') or '-'):<11}"
             f"{_fmt_depth(entry):<7}"
+            f"{_fmt_mesh(entry):<6}"
             f"{str(entry.get('source') or '-'):<7}"
             f"{workload[:27]:<28}"
             f"{rounds_text:>7}"
@@ -93,6 +105,8 @@ def format_record(record: dict[str, Any]) -> str:
                 if isinstance(record.get("pipeline_depth"), int)
                 and not isinstance(record.get("pipeline_depth"), bool)
                 else "")
+             + (f"/mesh={_fmt_mesh(record)}"
+                if _fmt_mesh(record) != "-" else "")
              + ("/resumed" if record.get("resumed") else "") + "]"]
     lines.append(
         f"  run_id={record.get('run_id') or '-'} "
@@ -190,6 +204,11 @@ def format_compare(diff: dict[str, Any]) -> str:
     if depth.get("old") != depth.get("new"):
         lines.append(f"  pipeline depth: {depth.get('old')} -> "
                      f"{depth.get('new')}  [different depths are "
+                     "non-peers for rolling baselines]")
+    mesh = diff.get("mesh_devices") or {}
+    if mesh.get("old") != mesh.get("new"):
+        lines.append(f"  mesh devices: {mesh.get('old')} -> "
+                     f"{mesh.get('new')}  [different mesh sizes are "
                      "non-peers for rolling baselines]")
 
     def render(title: str, columns: dict[str, Any], pct: bool = True):

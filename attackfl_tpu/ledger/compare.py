@@ -171,6 +171,8 @@ def compare_records(old: dict[str, Any],
         "executor": {"old": old.get("executor"), "new": new.get("executor")},
         "pipeline_depth": {"old": old.get("pipeline_depth"),
                            "new": new.get("pipeline_depth")},
+        "mesh_devices": {"old": old.get("mesh_devices"),
+                         "new": new.get("mesh_devices")},
         "perf": perf,
         "time_attribution": attribution,
         "phases": phases,
@@ -202,14 +204,30 @@ def rolling_baseline(records: list[dict[str, Any]],
     but throughput is exactly what depth changes, so records at
     different depths are non-peers for the rolling baseline (a depth-4
     run must not be gated against depth-0 history).  Non-pipelined
-    records carry None and keep matching each other."""
+    records carry None and keep matching each other.
+
+    The ``mesh_devices`` key (ISSUE 12, same lesson again): mesh size
+    is a placement knob — fingerprints don't see it (num-devices: 0
+    means "whatever is visible"), yet throughput is exactly what it
+    changes, so an 8-device run must never be gated against 1-device
+    history.  Records predating the field carry None; ``0`` (explicitly
+    meshless) and None are treated as the same pool so old baselines
+    keep working."""
     fingerprint = candidate.get("fingerprint")
+
+    def mesh_key(record: dict[str, Any]) -> int:
+        value = record.get("mesh_devices")
+        if isinstance(value, bool) or not isinstance(value, int):
+            return 0
+        return value
+
     peers = [r for r in records
              if r is not candidate
              and r.get("fingerprint") == fingerprint
              and r.get("executor") == candidate.get("executor")
              and r.get("cell") == candidate.get("cell")
              and r.get("pipeline_depth") == candidate.get("pipeline_depth")
+             and mesh_key(r) == mesh_key(candidate)
              and (candidate.get("record_id") is None
                   or r.get("record_id") != candidate.get("record_id"))]
     if not peers or not fingerprint:
@@ -235,6 +253,7 @@ def rolling_baseline(records: list[dict[str, Any]],
         "executor": candidate.get("executor"),
         "cell": candidate.get("cell"),
         "pipeline_depth": candidate.get("pipeline_depth"),
+        "mesh_devices": candidate.get("mesh_devices"),
         "baseline_of": [r.get("record_id") for r in peers],
     }
     for key, _ in PERF_COLUMNS:

@@ -274,6 +274,17 @@ def derive_record(events: list[dict[str, Any]],
     # roofline join — per-round flops/bytes against the MEASURED
     # round_device_time mined above.  CPU and unknown device kinds carry
     # achieved-only figures (no peak spec → no utilization fraction).
+    # mesh provenance (ISSUE 12): the run header's device-mesh size is a
+    # NON-PEER baseline key (compare.rolling_baseline — the PR-10 depth
+    # lesson: throughput is exactly what the mesh changes, so a 1-device
+    # and an 8-device run of the same fingerprint must never share a
+    # rolling baseline), and the roofline divides by it so utilization
+    # stays per-chip-honest on slices
+    mesh_devices = header.get("mesh_devices")
+    if isinstance(mesh_devices, bool) or not isinstance(mesh_devices, int):
+        mesh_devices = 0
+    mesh_strategy = header.get("mesh_strategy")
+
     programs = profiles_from_events(events) or None
     utilization = None
     if programs:
@@ -282,7 +293,7 @@ def derive_record(events: list[dict[str, Any]],
         utilization = utilization_summary(
             programs,
             (attribution["device_compute_s"] / rounds) if rounds else None,
-            device_kind)
+            device_kind, mesh_devices=mesh_devices)
 
     steady = rates.get("rounds_per_sec_steady")
     record: dict[str, Any] = {
@@ -297,6 +308,9 @@ def derive_record(events: list[dict[str, Any]],
                                       if configured is not None else None),
         "pipeline_depth_effective": ((0 if demoted else depth)
                                      if depth is not None else None),
+        "mesh_devices": mesh_devices,
+        "mesh_strategy": (str(mesh_strategy)
+                          if mesh_strategy is not None else None),
         "resumed": summary.get("resumed_from") is not None,
         "fingerprint": fingerprint,
         "git_rev": str(header.get("git_rev") or ""),
@@ -476,6 +490,34 @@ def records_from_bench(parsed: dict[str, Any]) -> list[dict[str, Any]]:
                 if isinstance(detail.get("auto_pick"), dict):
                     record["auto_pick"] = detail["auto_pick"]
                 records.append(record)
+    elif metric.startswith("fl_mesh_sweep"):
+        # mesh sweep (ISSUE 12): one record per (device count x
+        # workload) so every mesh size gets its own baseline trajectory
+        # — `mesh_devices` rides the record, making sizes non-peers for
+        # `ledger regress` exactly like engine-run records (the PR-10
+        # depth-key lesson)
+        by_devices = detail.get("by_devices")
+        if isinstance(by_devices, dict):
+            def dev_key(name: str) -> int:
+                return int(name) if str(name).isdigit() else -1
+
+            for key in sorted(by_devices, key=dev_key):
+                child = by_devices[key]
+                if not isinstance(child, dict):
+                    continue
+                for workload, executor in (("fused", "fused"),
+                                           ("matrix", "matrix")):
+                    block = child.get(workload)
+                    if not isinstance(block, dict):
+                        continue
+                    record = rate_record(f"{workload}@{key}dev", executor,
+                                         block)
+                    if dev_key(key) > 0:
+                        record["mesh_devices"] = dev_key(key)
+                    speedups = detail.get(f"{workload}_speedup")
+                    if isinstance(speedups, dict) and key in speedups:
+                        record["mesh_speedup"] = speedups[key]
+                    records.append(record)
     elif metric.startswith("fl_compile_cache"):
         for variant in ("first_run", "warm_cache"):
             block = detail.get(variant)

@@ -52,7 +52,8 @@ INDEX_VERSION = 1
 INDEX_FIELDS = ("record_id", "ts", "run_id", "fingerprint", "executor",
                 "source", "mode", "model", "total_clients", "rounds",
                 "ok_rounds", "rounds_per_sec_steady", "sweep_id", "cell",
-                "pipeline_depth", "pipeline_depth_effective")
+                "pipeline_depth", "pipeline_depth_effective",
+                "mesh_devices")
 
 
 def resolve_ledger_dir(explicit: str | None = None,

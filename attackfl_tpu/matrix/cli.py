@@ -49,6 +49,11 @@ def run_main(argv: list[str] | None = None) -> int:
                         help="continue an interrupted sweep from its "
                              "newest valid checkpoint (byte-identical "
                              "grid)")
+    parser.add_argument("--mesh", action="store_true",
+                        help="shard the sweep's CELL axis across all "
+                             "visible devices (cells are embarrassingly "
+                             "parallel; per-cell results stay "
+                             "bit-identical)")
     args = parser.parse_args(argv)
 
     import yaml
@@ -89,11 +94,14 @@ def run_main(argv: list[str] | None = None) -> int:
 
     from attackfl_tpu.training.matrix_exec import MatrixRun
 
-    runner = MatrixRun(cfg, grid, sweep_id=args.sweep_id)
+    runner = MatrixRun(cfg, grid, sweep_id=args.sweep_id,
+                       use_mesh=args.mesh)
     print_with_color(
         f"[matrix] sweep {runner.sweep_id}: {grid.n_cells} cells "
         f"({len(runner.device_cells)} in the compiled grid, "
-        f"{len(runner.fallback_cells)} per-cell fallback)", "cyan")
+        f"{len(runner.fallback_cells)} per-cell fallback"
+        + (f"; cell axis over {runner.mesh.size} devices"
+           if runner.mesh is not None else "") + ")", "cyan")
     try:
         final_params, histories = runner.run()
     finally:

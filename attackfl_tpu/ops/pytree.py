@@ -101,20 +101,36 @@ def pairwise_ref_distance(stacked: Pytree, matrix_spectral: bool = False) -> jnp
     ``||xi−xj||² = ||xi||² + ||xj||² − 2⟨xi,xj⟩`` per leaf, avoiding the
     (N, N, leaf) broadcast tensor (which would OOM for big models under a
     vmap over attackers); only the opt-in spectral path materializes diffs.
+
+    Two conditioning guards keep the identity honest in f32:
+
+    * rows are centered (per leaf) first — distances are translation-
+      invariant, and the expansion's cancellation error scales with
+      ``||xi||·||xj||·eps``, which for stacked FL updates is dominated by
+      the broadcast global params every row shares.  Centering removes
+      that common component, so the norms entering the subtraction are
+      the (small) deviations whose differences we actually want.
+    * the diagonal is pinned to exactly 0: mathematically
+      ``||xi−xi|| = 0``, but the expansion leaves ``O(||xi||²·eps)``
+      residue whose sqrt (~||xi||·3e-4) exceeded the naive formulation's
+      error by 10x (the old test failure: 3.3e-3 where the true distance
+      is 0.0).
     """
     leaves = jax.tree.leaves(stacked)
     n = leaves[0].shape[0]
     total = jnp.zeros((n, n))
+    eye = jnp.eye(n, dtype=bool)
     for x in leaves:
         if matrix_spectral and x.ndim - 1 == 2:
             diff = x[:, None] - x[None, :]  # (N, N, r, c)
             norms = jnp.linalg.norm(diff, ord=2, axis=(-2, -1))
         else:
             flat = x.reshape(n, -1)
+            flat = flat - jnp.mean(flat, axis=0, keepdims=True)
             sq_norms = jnp.sum(jnp.square(flat), axis=1)
             gram = flat @ flat.T
             sq = sq_norms[:, None] + sq_norms[None, :] - 2.0 * gram
-            norms = jnp.sqrt(jnp.maximum(sq, 0.0))
+            norms = jnp.sqrt(jnp.where(eye, 0.0, jnp.maximum(sq, 0.0)))
         total = total + norms
     return total
 

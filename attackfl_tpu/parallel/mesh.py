@@ -141,6 +141,28 @@ def replicate_to_mesh(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
+def replicate_local(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a tree onto every device of a SINGLE-PROCESS mesh via
+    ``device_put`` (a real copy per device — donation-safe, unlike
+    :func:`replicate_to_mesh`'s ``make_array_from_callback`` shards,
+    which alias one host buffer and corrupt memory on jax 0.4.37 when
+    the consuming program donates them).  Typed PRNG keys ride their raw
+    uint32 data, like :func:`shard_stacked`."""
+    rep = NamedSharding(mesh, P())
+
+    def put(x):
+        if not hasattr(x, "shape"):
+            return x
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(x)
+            data = jax.device_put(jax.random.key_data(x), rep)
+            return jax.random.wrap_key_data(data, impl=impl)
+        return jax.device_put(x, rep)
+
+    return jax.tree.map(put, tree)
+
+
 def gather_to_host(tree: Any) -> Any:
     """Materialize a (possibly DCN-sharded) state tree as host-local numpy
     on EVERY process — the gather half of multi-host checkpointing (the
@@ -216,23 +238,94 @@ def replicate(mesh: Mesh) -> NamedSharding:
 
 
 def shard_stacked(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
-    """Place a stacked client tree with its leading axis split over the
-    mesh (the "broadcast" of the reference, minus the broker)."""
-    sharding = client_sharding(mesh, axis_name)
-    return jax.device_put(tree, sharding)
+    """Place a stacked tree with its leading axis split over the mesh
+    (the "broadcast" of the reference, minus the broker).  Rank-aware and
+    typed-PRNG-key aware, like :func:`make_constrain`: keys are placed
+    through their raw uint32 data so the physical rank always matches
+    the tile assignment.  Used for both the client axis (round programs)
+    and the scenario matrix's CELL axis (the grid state's leading axis
+    is cells — embarrassingly parallel, same placement primitive)."""
+
+    def put(x):
+        if not hasattr(x, "ndim") or getattr(x, "ndim", 0) < 1:
+            return x
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(x)
+            data = jax.random.key_data(x)
+            data = jax.device_put(
+                data, NamedSharding(mesh, leading_axis_spec(data, axis_name)))
+            return jax.random.wrap_key_data(data, impl=impl)
+        return jax.device_put(
+            x, NamedSharding(mesh, leading_axis_spec(x, axis_name)))
+
+    return jax.tree.map(put, tree)
+
+
+def shard_map_clients(fn, mesh: Mesh, in_specs, out_specs, check: bool = False):
+    """Version-portable ``shard_map`` (the 1-D client-axis entry point).
+
+    jax 0.4.x ships it under ``jax.experimental.shard_map`` with a
+    ``check_rep`` flag; newer jax promotes it to ``jax.shard_map`` with
+    ``check_vma``.  ``check`` defaults off: 0.4.37's replication checker
+    cannot see that an ``all_gather``-then-reduce body is replicated
+    (it rejects legitimate ``out_specs=P()`` programs), and the jaxpr
+    auditor (:mod:`attackfl_tpu.analysis.program_audit`) verifies the
+    program's collective structure independently."""
+    try:  # jax >= 0.6
+        from jax import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check)
+
+
+def leading_axis_spec(x, axis_name: str = "clients") -> P:
+    """Rank-aware PartitionSpec: leading axis on the mesh, every other
+    dimension explicitly replicated.  GSPMD accepts the short ``P(ax)``
+    for ordinary arrays, but jax 0.4.37 builds the HloSharding from the
+    LOGICAL rank — for a typed PRNG key array of shape (C,) the physical
+    ``u32[C, key_words]`` data then meets a rank-1 tile assignment and
+    XLA rejects the program ("tile assignment dimensions different than
+    input rank", the training/local.py:165 while-loop failure).  Spell
+    every dimension out so logical and physical ranks cannot diverge."""
+    ndim = getattr(x, "ndim", 1)
+    return P(axis_name, *([None] * (max(ndim, 1) - 1)))
 
 
 def make_constrain(mesh: Mesh | None, axis_name: str = "clients"):
     """Return a function pinning a stacked tree's leading axis to the mesh
     inside jit (identity when mesh is None).  Used by the round builders to
-    keep the vmapped local-training compute sharded client-wise."""
+    keep the vmapped local-training compute sharded client-wise.
+
+    Typed PRNG key arrays are constrained through their raw uint32 key
+    data with a rank-aware spec (see :func:`leading_axis_spec`): jax
+    0.4.37 lowers a sharding constraint on an extended-dtype array from
+    its logical rank, which poisons the physical ``u32[C, words]`` matrix
+    with a rank-mismatched tile assignment inside the training while
+    loop — the root cause of the PR-1..11 seed failures in
+    tests/test_sharding.py."""
     if mesh is None:
         return lambda tree: tree
-    sharding = NamedSharding(mesh, P(axis_name))
+
+    def constrain_leaf(x):
+        if not hasattr(x, "ndim"):
+            return x
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(x)
+            data = jax.random.key_data(x)
+            data = jax.lax.with_sharding_constraint(
+                data, NamedSharding(mesh, leading_axis_spec(data, axis_name)))
+            return jax.random.wrap_key_data(data, impl=impl)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, leading_axis_spec(x, axis_name)))
 
     def constrain(tree):
-        return jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, sharding), tree
-        )
+        return jax.tree.map(constrain_leaf, tree)
 
     return constrain

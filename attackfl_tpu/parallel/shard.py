@@ -1,0 +1,186 @@
+"""shard_map client-axis execution (ISSUE 12 tentpole).
+
+The GSPMD path (:func:`attackfl_tpu.parallel.mesh.make_constrain`) lets
+the XLA partitioner slice one global program; this module instead maps
+the round's two halves EXPLICITLY over a 1-D ``clients`` mesh with
+``shard_map``:
+
+* **local-epoch training** runs on device-local client shards — each
+  device compiles a ``C/n_dev``-client program with zero collectives
+  (the epoch/batch while-loops never see a sharded operand, which also
+  sidesteps the jax 0.4.37 extended-dtype sharding bug entirely);
+* **aggregation/defense** becomes in-program collectives, with
+  ``psum``/``all_gather`` only where the defense genuinely needs
+  cross-shard data:
+
+  ========================  =============  ==============================
+  defense                   collectives    why
+  ========================  =============  ==============================
+  fedavg / fltracer / gmm   psum           weighted mean = partial sums
+  shieldfl                  psum           mean-unit reference + weighted
+                                           mean are both partial sums
+  FLTrust                   psum           root pass is replicated; trust
+                                           scores are per-client locals,
+                                           the combine is a partial sum
+  median / trimmed_mean     all_gather     per-coordinate order statistics
+  krum                      all_gather     pairwise distance matrix
+  scionfl                   all_gather     global cosine-distance quantile
+  byzantine                 all_gather     anchor row lives on one shard
+  ========================  =============  ==============================
+
+The jaxpr auditor asserts this table against the traced programs
+(:data:`attackfl_tpu.analysis.program_audit.EXPECTED_COLLECTIVES`).
+
+**PRNG discipline**: hardware-RNG (``rbg``) bits depend on the batch
+shape they are generated under, so a device-local ``C/n``-client block
+draws DIFFERENT bits than the same clients inside the global ``C``-wide
+program (measured ~1e-1 on params after one round — the same lesson as
+the PR-9 matrix/vmap constraint).  ``threefry2x32`` is counter-based and
+bit-stable under any batching, so the engine routes mesh runs through
+shard_map only for threefry configs and keeps rbg configs on the
+partitioned-GSPMD path, where the bits are the single-program bits by
+construction.  :func:`supports_shard_map` states the rule once.
+
+Everything here is traced-only: the host-sync lint runs over this file
+with NO allowlist (a collective is device-device, never device-host).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.parallel.mesh import shard_map_clients
+
+# Defense modes whose aggregation decomposes into per-shard partial sums
+# (one or two psum stages, no cross-shard ordering anywhere).
+PSUM_MODES = frozenset({"fedavg", "fltracer", "gmm", "shieldfl", "FLTrust"})
+# Defense modes that need the full (C, P) matrix in one place: order
+# statistics, pairwise distances, global quantiles, or a specific row.
+GATHER_MODES = frozenset({"median", "trimmed_mean", "krum", "scionfl",
+                          "byzantine"})
+
+
+def supports_shard_map(cfg) -> bool:
+    """True when this config's mesh execution may use shard_map: plain
+    (non-hyper) modes under a bit-stable counter-based PRNG.  rbg/
+    unsafe_rbg hardware keys draw batch-shape-dependent bits, so a
+    device-local client block would diverge from the single-program
+    trajectory — those configs stay on the partitioned-GSPMD path."""
+    return cfg.prng_impl == "threefry2x32" and cfg.mode != "hyper"
+
+
+def shard_local_update(batched_update: Callable, mesh,
+                       axis_name: str = "clients") -> Callable:
+    """Map ``batched_update(global_params, keys, idx, mask) -> (stacked,
+    ok, losses)`` over device-local client shards.  Params replicate in;
+    every per-client operand/result shards on the leading axis.  The
+    mapped body contains no collectives — training is embarrassingly
+    parallel over clients."""
+    ax = axis_name
+    return shard_map_clients(
+        batched_update, mesh,
+        in_specs=(P(), P(ax), P(ax), P(ax)),
+        out_specs=(P(ax), P(ax), P(ax)))
+
+
+def _psum_weighted_mean(stacked: Any, weights: jnp.ndarray,
+                        axis_name: str) -> Any:
+    """Size-weighted mean over ALL clients from one shard's block: local
+    partial sums + one psum pair.  The division happens after the psum so
+    every device returns the identical replicated tree."""
+    total_w = jax.lax.psum(jnp.sum(weights), axis_name)
+
+    def wmean(x):
+        wb = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return jax.lax.psum(jnp.sum(x * wb, axis=0), axis_name) / total_w
+
+    return jax.tree.map(wmean, stacked)
+
+
+def shard_aggregator(aggregate: Callable, mode: str, mesh,
+                     axis_name: str = "clients") -> Callable:
+    """Wrap a :func:`~attackfl_tpu.training.round.build_aggregator`
+    callable ``(global_params, stacked, sizes, weights_mask, rng) ->
+    new_global`` so the (C, P) client axis arrives device-local and the
+    reduction happens via in-program collectives (table in the module
+    doc).  The wrapped function has the identical signature and returns
+    the replicated global tree.
+
+    ``psum`` modes re-derive the aggregate from partial sums — same math,
+    shard-count-dependent float association (parity is tolerance-level,
+    like any reduction reorder).  ``all_gather`` modes reassemble the
+    full matrix per device and run the UNCHANGED aggregator on it, so
+    their results are bit-identical to the single-device program.
+    """
+    ax = axis_name
+
+    if mode in ("fedavg", "fltracer"):
+        def body(global_params, stacked, sizes, weights_mask, rng):
+            return _psum_weighted_mean(
+                stacked, sizes.astype(jnp.float32) * weights_mask, ax)
+    elif mode == "gmm":
+        def body(global_params, stacked, sizes, weights_mask, rng):
+            return _psum_weighted_mean(stacked, weights_mask, ax)
+    elif mode == "shieldfl":
+        def body(global_params, stacked, sizes, weights_mask, rng):
+            # stage 1: replicated reference direction from psum'd unit
+            # sums.  Mask-aware like aggregators.shieldfl_weights' masked
+            # branch; with the all-ones mask of a dropout-free round the
+            # normalizer equals the client count and this reduces to the
+            # unmasked mean(unit) formulation exactly.
+            flat = pt.tree_ravel_stacked(stacked)
+            unit = flat / (jnp.linalg.norm(flat, axis=1, keepdims=True)
+                           + 1e-8)
+            m = weights_mask.astype(flat.dtype)
+            n = jnp.maximum(jax.lax.psum(jnp.sum(m), ax), 1.0)
+            ref = jax.lax.psum(jnp.sum(unit * m[:, None], axis=0), ax) / n
+            # stage 2: local weights against the replicated reference
+            cos = (unit @ ref) / (jnp.linalg.norm(unit, axis=1)
+                                  * jnp.linalg.norm(ref) + 1e-12)
+            weights = m * (1.0 / (1.0 - cos + 1e-6))
+            # stage 3: psum'd weighted mean
+            return _psum_weighted_mean(stacked, weights, ax)
+    elif mode == "FLTrust":
+        # `aggregate` here is ONLY the combine half: the root-trust pass
+        # runs replicated OUTSIDE the shard_map (build_aggregator splits
+        # it when a mesh is present) — root_delta arrives as an operand.
+        def body(global_params, deltas, root_delta, _unused_rng):
+            flat_deltas = pt.tree_ravel_stacked(deltas)
+            flat_root = pt.tree_ravel(root_delta)
+            norm_root = jnp.linalg.norm(flat_root)
+            norms = jnp.linalg.norm(flat_deltas, axis=1)
+            cos = (flat_deltas @ flat_root) / (norms * norm_root + 1e-12)
+            trust = jnp.maximum(cos, 0.0)
+            scale = (norm_root / (norms + 1e-6)) * trust
+            total_trust = jax.lax.psum(jnp.sum(trust), ax) + 1e-6
+
+            def combine(g, d):
+                s = scale.reshape((-1,) + (1,) * (d.ndim - 1))
+                upd = jax.lax.psum(jnp.sum(d * s, axis=0), ax) / total_trust
+                return g + upd
+
+            return jax.tree.map(combine, global_params, deltas)
+
+        return shard_map_clients(
+            body, mesh,
+            in_specs=(P(), P(ax), P(), P()),
+            out_specs=P())
+    elif mode in GATHER_MODES:
+        def body(global_params, stacked, sizes, weights_mask, rng):
+            full = jax.tree.map(
+                lambda x: jax.lax.all_gather(x, ax, tiled=True), stacked)
+            full_sizes = jax.lax.all_gather(sizes, ax, tiled=True)
+            full_mask = jax.lax.all_gather(weights_mask, ax, tiled=True)
+            return aggregate(global_params, full, full_sizes, full_mask, rng)
+    else:
+        raise ValueError(f"no sharded aggregation for mode {mode!r}")
+
+    return shard_map_clients(
+        body, mesh,
+        in_specs=(P(), P(ax), P(ax), P(ax), P()),
+        out_specs=P())

@@ -108,6 +108,15 @@ Schema v9 (ISSUE 11) extends v8 — every v1-v8 file still validates:
   optional — a raising backend analysis degrades to a partial profile,
   never an absent event.
 
+Schema v10 (ISSUE 12) extends v9 — every v1-v9 file still validates:
+
+* ``run_header`` MAY carry ``mesh_strategy`` (``"shard_map"`` — the
+  mesh-native executors mapping training over device-local client
+  shards with collective aggregation — or ``"gspmd"``, the partitioned
+  single program) and the long-emitted ``mesh_devices`` device count is
+  now type-checked when present.  No new kinds: the ledger mines both
+  for the ``mesh_devices`` non-peer baseline key.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -124,7 +133,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -215,6 +224,9 @@ _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     "monitor_port": int,
     "sweep_id": str, "cell": str,
     "pipeline_depth": int, "pipeline_depth_configured": str,
+    # v10: mesh provenance (ISSUE 12) — the executor's mesh strategy and
+    # the device count the ledger's non-peer baseline key reads
+    "mesh_strategy": str, "mesh_devices": int,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -238,6 +250,9 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     8: frozenset(),
     # + optional cost payload fields on the new kind itself
     9: frozenset({"program_profile"}),
+    # v10 adds no kinds — only the optional run_header mesh fields
+    # (ISSUE 12), like v8's pipeline-depth pair
+    10: frozenset(),
 }
 
 

@@ -156,6 +156,7 @@ class Simulator:
         use_mesh: bool = False,
         mesh=None,
         telemetry: Telemetry | None = None,
+        mesh_strategy: str | None = None,
     ):
         self.cfg = cfg
         self.logger = logger or Logger(f"{cfg.log_path}/app.log")
@@ -238,6 +239,36 @@ class Simulator:
                 "copy — run it single-process (the reference it replicates "
                 "is single-server, server.py:578-586)"
             )
+        # Mesh execution strategy (ISSUE 12): "shard_map" maps the
+        # training half over device-local client shards and turns the
+        # aggregation/defense chain into in-program collectives
+        # (parallel/shard); "gspmd" keeps the partitioned single program
+        # (sharding constraints only).  Auto picks shard_map exactly when
+        # the PRNG is bit-stable under re-batching (threefry) and the
+        # mode is plain — rbg hardware keys draw batch-shape-dependent
+        # bits, so a device-local client block would diverge from the
+        # single-program trajectory (parallel/shard.supports_shard_map).
+        self.mesh_strategy: str | None = None
+        if self.mesh is not None:
+            from attackfl_tpu.parallel.shard import supports_shard_map
+
+            if mesh_strategy is None:
+                self.mesh_strategy = ("shard_map" if supports_shard_map(cfg)
+                                      else "gspmd")
+            else:
+                if mesh_strategy not in ("shard_map", "gspmd"):
+                    raise ValueError(
+                        f"unknown mesh_strategy {mesh_strategy!r}; choose "
+                        "'shard_map' or 'gspmd'")
+                if mesh_strategy == "shard_map" and not supports_shard_map(cfg):
+                    raise ValueError(
+                        "mesh_strategy 'shard_map' needs prng_impl "
+                        "threefry2x32 on a plain (non-hyper) mode: rbg "
+                        "hardware keys draw batch-shape-dependent bits, so "
+                        "device-local client blocks cannot reproduce the "
+                        "single-program trajectory (parallel/shard)")
+                self.mesh_strategy = mesh_strategy
+        self._use_shard_map = self.mesh_strategy == "shard_map"
         constrain = make_constrain(self.mesh, cfg.mesh.axis_name)
 
         # ---- telemetry --------------------------------------------------
@@ -401,10 +432,13 @@ class Simulator:
             round_step = build_round_step(
                 self.model, cfg, self.train_data, self.attack_groups,
                 self.genuine_idx, self.client_pools, constrain, mesh=self.mesh,
+                use_shard_map=self._use_shard_map,
             )
             self.round_step = jax.jit(round_step)
             self._round_step_raw = round_step
-            aggregate = build_aggregator(self.model, cfg, test_np)
+            aggregate = build_aggregator(
+                self.model, cfg, test_np,
+                mesh=self.mesh if self._use_shard_map else None)
             # donate the stacked client deltas — the (C, P)-scale buffer.
             # Aggregation is dispatched after every other consumer (the
             # host defenses and the attribution program read it first), so
@@ -756,6 +790,26 @@ class Simulator:
             state = replicate_to_mesh(state, self.mesh)
         return self._ensure_numerics_state(state)
 
+    def _place_on_mesh(self, state: dict[str, Any]) -> dict[str, Any]:
+        """Canonical mesh placement of a run-entry state (ISSUE 12):
+        replicate every leaf onto the mesh so the FIRST dispatch compiles
+        the same input shardings every later round produces (the round
+        programs' state outputs are replicated by construction — the
+        shard_map aggregate's ``out_specs=P()``, the round_step leak-pool
+        constraint).  Without this, round 1 runs on host-placed arrays
+        and round 2 re-specializes the jit for the device shardings —
+        one wasted multi-second compile per program on real silicon, and
+        a retrace-guard violation here.  Multiprocess states are already
+        replicated (init/resume paths).  ``replicate_local`` copies per
+        device — the fused/pipelined paths DONATE this state, and
+        ``replicate_to_mesh``'s callback-built shards alias one host
+        buffer (donating those corrupts memory on jax 0.4.37)."""
+        if self.mesh is None or self.multiprocess:
+            return state
+        from attackfl_tpu.parallel.mesh import replicate_local
+
+        return replicate_local(state, self.mesh)
+
     def _ensure_numerics_state(self, state: dict[str, Any]) -> dict[str, Any]:
         """Attach the numerics ring to a state that lacks one (fresh init,
         checkpoint resume, or a state built before numerics was enabled).
@@ -959,6 +1013,11 @@ class Simulator:
             backend=jax.default_backend(),
             num_devices=len(jax.devices()),
             mesh_devices=self.mesh.size if self.mesh is not None else 0,
+            # schema v10: how the mesh executes (shard_map = mesh-native
+            # collectives, gspmd = partitioned single program); absent on
+            # non-mesh runs
+            **({"mesh_strategy": self.mesh_strategy}
+               if self.mesh_strategy is not None else {}),
             multiprocess=self.multiprocess,
             mode=self.cfg.mode,
             model=self.cfg.model,
@@ -1210,6 +1269,8 @@ class Simulator:
         if self.monitor is None:
             return
         first = self.monitor.port is None
+        if self.mesh is not None:
+            self.monitor.set_mesh(self.mesh.size, self.mesh_strategy)
         self.monitor.start().run_started()
         if first:
             print_with_color(
@@ -1917,7 +1978,10 @@ class Simulator:
             # numerics-off one: the fused body would drop the key from the
             # scan carry (structure mismatch) — drop it up front instead
             out.pop("numerics", None)
-        return out
+        # mesh runs: canonical replicated placement AFTER the casts above
+        # (a cast re-materializes the leaf on the default device, which
+        # would undo an earlier placement) — see _place_on_mesh
+        return self._place_on_mesh(out)
 
     def run_scan(
         self, state: dict[str, Any], num_broadcasts: int
@@ -2512,8 +2576,8 @@ class Simulator:
         through the ``finally`` drains)."""
         cfg = self.cfg
         num_rounds = num_rounds if num_rounds is not None else cfg.num_round
-        state = self._ensure_numerics_state(
-            state if state is not None else self.load_or_init_state())
+        state = self._place_on_mesh(self._ensure_numerics_state(
+            state if state is not None else self.load_or_init_state()))
         use_pipeline = cfg.pipeline if pipeline is None else pipeline
         depth = None
         if use_pipeline and self.supports_fused():

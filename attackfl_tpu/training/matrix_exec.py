@@ -101,10 +101,33 @@ class MatrixRun:
 
     def __init__(self, cfg: Config, grid: GridSpec,
                  sweep_id: str | None = None,
-                 telemetry: Telemetry | None = None):
+                 telemetry: Telemetry | None = None,
+                 use_mesh: bool = False,
+                 mesh=None):
         grid.validate_base(cfg)
         self.cfg = cfg
         self.grid = grid
+        # ---- CELL-axis mesh (ISSUE 12) ---------------------------------
+        # Cells are embarrassingly parallel: the grid state's leading
+        # axis shards across the device mesh (placement at init/resume +
+        # an in-program constraint per chunk), so a 45-cell sweep scales
+        # near-linearly with devices.  No divisibility requirement — the
+        # partitioner pads uneven cell counts.  Per-cell results stay
+        # bit-identical: partitioning splits the vmapped cell batch, it
+        # never re-associates any within-cell reduction (the sweep's
+        # threefry requirement already guarantees bit-stable keys).
+        self.mesh = mesh
+        if use_mesh and mesh is None:
+            from attackfl_tpu.parallel.mesh import make_client_mesh
+
+            self.mesh = make_client_mesh(cfg.mesh.num_devices,
+                                         cfg.mesh.axis_name)
+        self._cell_constrain = None
+        if self.mesh is not None:
+            from attackfl_tpu.parallel.mesh import make_constrain
+
+            self._cell_constrain = make_constrain(
+                self.mesh, cfg.mesh.axis_name)
         self.sweep_id = sweep_id or uuid.uuid4().hex[:12]
         self.cells = expand_cells(grid)
         self.device_cells = [c for c in self.cells
@@ -204,6 +227,24 @@ class MatrixRun:
                         round_step, fl_branch, cfg.total_clients, eval_fn,
                         cfg.validation_every, self._numerics_step_raw)),
                 }
+        # ---- cell-axis padding for the mesh ----------------------------
+        # jax 0.4.37 requires the sharded axis to divide the mesh, so
+        # each BATCHED group's cell axis is padded with clones of its
+        # first cell up to the next multiple of the device count: the
+        # pad rows ride the same vmapped program (bounded waste, ~(n_dev
+        # - 1) cells worst case) and are invisible to resolve/progress/
+        # final-params, which all iterate the REAL cell list.  Mapped
+        # (lax.map) groups stay replicated — their slices run
+        # sequentially, so sharding them buys nothing.
+        for name, group in self.groups.items():
+            pad = 0
+            if (self.mesh is not None and group["kind"] == "batched"):
+                pad = (-len(group["cells"])) % self.mesh.size
+                if pad and group["defense_idx"] is not None:
+                    group["defense_idx"] = jnp.concatenate(
+                        [group["defense_idx"],
+                         jnp.repeat(group["defense_idx"][:1], pad)])
+            group["pad"] = pad
         self._matrix_body = build_matrix_body(self.groups)
         # jitted chunk programs keyed by (scan length, donate) — the
         # attribute NAME matches the engine's so the retrace guard
@@ -299,11 +340,14 @@ class MatrixRun:
     def init_state(self) -> dict[str, Any]:
         """The grid state: per compile group, every cell's state stacked
         on the leading axis (cell init happens UNBATCHED, so slice 0 of
-        the stack is byte-equal to the standalone init)."""
+        the stack is byte-equal to the standalone init).  Under a mesh,
+        batched groups carry ``pad`` clone rows of their first cell so
+        the cell axis divides the device count (see ``__init__``)."""
         out: dict[str, Any] = {}
         for name, group in self.groups.items():
             per_cell = [self._cell_host_state(c.seed)
                         for c in group["cells"]]
+            per_cell += [per_cell[0]] * group.get("pad", 0)
             out[name] = jax.tree.map(
                 lambda *leaves: jnp.stack(leaves), *per_cell)
         return out
@@ -318,7 +362,9 @@ class MatrixRun:
         out = {}
         for name, sub in state.items():
             if "numerics" not in sub:
-                n = len(self.groups[name]["cells"])
+                # padded cell count under a mesh — match the state's own
+                # leading axis, not the real-cell list
+                n = int(sub["completed_rounds"].shape[0])
                 ring = self._numerics.init_state()
                 sub = dict(sub, numerics=jax.tree.map(
                     lambda leaf: jnp.stack([leaf] * n), ring))
@@ -379,8 +425,21 @@ class MatrixRun:
         if fn is None:
             self.telemetry.counters.inc("round_program_cache_misses")
             body = self._matrix_body
+            constrain = self._cell_constrain
+
+            batched = {name for name, g in self.groups.items()
+                       if g["kind"] == "batched"}
 
             def chunk(state):
+                if constrain is not None:
+                    # pin the batched groups' (padded) cell axis to the
+                    # mesh at scan entry so the carry stays sharded
+                    # across the chunk (the constrain is key-data-aware
+                    # — see parallel/mesh.make_constrain); mapped groups
+                    # run sequentially and stay replicated
+                    state = {name: (constrain(sub) if name in batched
+                                    else sub)
+                             for name, sub in state.items()}
                 return jax.lax.scan(body, state, None, length=length)
 
             fn = jax.jit(chunk, donate_argnums=(0,) if donate else ())
@@ -444,11 +503,19 @@ class MatrixRun:
     def audit_programs(self, state: dict[str, Any] | None = None
                        ) -> list[dict[str, Any]]:
         """The batched grid program for the jaxpr/HLO auditor — same
-        contract as ``Simulator.audit_programs``."""
+        contract as ``Simulator.audit_programs``.  Under a mesh the
+        audited step includes the cell-axis constraint exactly as
+        ``_matrix_chunk`` dispatches it."""
         state = self._ensure_numerics(
             state if state is not None else self.init_state())
+        constrain = self._cell_constrain
+        batched = {name for name, g in self.groups.items()
+                   if g["kind"] == "batched"}
 
         def step(s):
+            if constrain is not None:
+                s = {name: (constrain(sub) if name in batched else sub)
+                     for name, sub in s.items()}
             return self._matrix_body(s, None)
 
         return [dict(
@@ -469,6 +536,7 @@ class MatrixRun:
             "run_header",
             backend=jax.default_backend(),
             num_devices=len(jax.devices()),
+            mesh_devices=self.mesh.size if self.mesh is not None else 0,
             mode="matrix",
             model=self.cfg.model,
             data_name=self.cfg.data_name,
@@ -544,6 +612,18 @@ class MatrixRun:
     def _save_checkpoint(self, state: dict[str, Any],
                          completed: int) -> None:
         target = self._strip_numerics(state)
+        if self.mesh is not None:
+            # gather-at-checkpoint (ISSUE 12): the cell-sharded grid
+            # state funnels through the same seam the engine uses for
+            # DCN meshes — single-process sharded arrays materialize via
+            # host_state's np conversion; a multi-process mesh needs the
+            # explicit all-gather so every host serializes the SAME bytes
+            from attackfl_tpu.parallel.mesh import (
+                gather_to_host, is_multiprocess,
+            )
+
+            if is_multiprocess(self.mesh):
+                target = gather_to_host(target)
         self._ckpt_manager.write(
             os.path.join(self.cfg.checkpoint_dir or ".", MATRIX_STATE_FILE),
             ckpt.host_state(target),
@@ -568,6 +648,18 @@ class MatrixRun:
                         fallback_cells=len(self.fallback_cells),
                         resumed=self._resumed)
         state = self.load_or_init_state()
+        if self.mesh is not None:
+            # place the batched groups' cell axis over the mesh up front
+            # — the resume path hands back host arrays, and letting the
+            # first dispatch re-shard would hide a full-state transfer
+            # in the first chunk's timing
+            from attackfl_tpu.parallel.mesh import shard_stacked
+
+            state = {name: (shard_stacked(sub, self.mesh,
+                                          self.cfg.mesh.axis_name)
+                            if self.groups[name]["kind"] == "batched"
+                            else sub)
+                     for name, sub in state.items()}
         histories: dict[str, list[dict[str, Any]]] = {}
         consecutive: dict[str, int] = {}
         interrupted = False
@@ -595,9 +687,12 @@ class MatrixRun:
                     fn = self._matrix_chunk(n, donate)
                     # AOT seam (cost observatory): dispatch the profiled
                     # executable when telemetry is on, exactly like
-                    # run_fast — the lazy jit path stays the fallback
+                    # run_fast — the lazy jit path stays the fallback.
+                    # Skipped under a mesh (AOT pins input shardings;
+                    # the lazy path re-shards freely — engine.run_scan's
+                    # rule).
                     exe = (self._matrix_executable((n, donate), fn, state)
-                           if tel.enabled else False)
+                           if tel.enabled and self.mesh is None else False)
                     state, metrics = (exe(state) if exe is not False
                                       else fn(state))
                     # the np.asarray inside _resolve_chunk IS the block:
@@ -762,7 +857,10 @@ class MatrixRun:
                 run_id=self.telemetry.events.run_id,
                 ts=time.time(), wall_s=wall, resumed=self._resumed,
                 provenance={"jax_version": jax.__version__,
-                            "backend": jax.default_backend()},
+                            "backend": jax.default_backend(),
+                            "mesh_devices": (self.mesh.size
+                                             if self.mesh is not None
+                                             else 0)},
                 programs=dict(self._program_profiles) or None)
             for record in records:
                 self._ledger.append(record)

@@ -55,14 +55,32 @@ def map_attackers(attack_one: Callable, xs: Any, n_attackers: int,
                   leak_k: int, params_template: Any) -> Any:
     """Evaluate the per-attacker closure over stacked inputs ``xs`` with
     bounded peak memory: plain vmap while the full (n_attackers, leak_k, P)
-    gather fits ``ATTACK_GATHER_BUDGET``, otherwise ``lax.map`` with a
-    batch size that keeps each chunk's gather under it (sequential chunks
-    of vmapped attackers — identical results, bounded temporaries)."""
+    gather fits ``ATTACK_GATHER_BUDGET``, otherwise sequential chunks of
+    vmapped attackers — identical results, bounded temporaries.
+
+    Chunked by hand rather than ``lax.map(batch_size=...)``: jax
+    0.4.37's remainder handling traces a ZERO-SIZE vmap when the batch
+    size divides the length exactly, and rbg typed keys cannot trace
+    ``random.choice`` over an empty key batch (IndexError) — exactly the
+    shape the reference-scale rbg configs hit when the budget chunk
+    lands on a divisor of the attacker count."""
     p_total = sum(x.size for x in jax.tree.leaves(params_template))
     chunk = max(1, ATTACK_GATHER_BUDGET // max(leak_k * p_total, 1))
     if chunk >= n_attackers:
         return jax.vmap(attack_one)(xs)
-    return jax.lax.map(attack_one, xs, batch_size=chunk)
+    rem = n_attackers % chunk
+    head_n = n_attackers - rem
+    head = jax.tree.map(
+        lambda x: x[:head_n].reshape((head_n // chunk, chunk)
+                                     + x.shape[1:]), xs)
+    out = jax.lax.map(jax.vmap(attack_one), head)
+    out = jax.tree.map(
+        lambda x: x.reshape((head_n,) + x.shape[2:]), out)
+    if rem:
+        tail = jax.vmap(attack_one)(jax.tree.map(lambda x: x[head_n:], xs))
+        out = jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0),
+                           out, tail)
+    return out
 
 
 @dataclass(frozen=True)
@@ -160,13 +178,18 @@ def build_round_step(
     client_pools: jnp.ndarray | None = None,
     constrain: Callable | None = None,
     mesh=None,
+    use_shard_map: bool = False,
 ) -> Callable:
     """Build ``round_step(global_params, prev_genuine, have_genuine, rng,
     broadcast_number) -> (stacked, sizes, new_genuine, ok, mean_loss)``.
 
     ``constrain`` (from parallel.mesh.make_constrain) pins stacked
     per-client tensors to the client mesh axis inside jit, sharding the
-    vmapped local-training compute across devices.
+    vmapped local-training compute across devices.  ``use_shard_map``
+    (with a mesh) maps the local-training half explicitly over
+    device-local client shards instead of leaving the split to the GSPMD
+    partitioner (parallel/shard — the engine gates it on
+    ``supports_shard_map``).
 
     ``prev_genuine`` is the stacked tree of the G genuine clients' previous
     updates; ``have_genuine`` is False until one round has completed.
@@ -210,18 +233,12 @@ def build_round_step(
             # client shard.  The grid already chunks clients; shard_map
             # splits the leading axis so each device's Pallas program sees
             # C/n_dev clients (params replicated, per-client rows sharded).
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
+            # check stays off: the pallas_call's ShapeDtypeStructs carry
+            # no replication info the checker could see through.
+            from attackfl_tpu.parallel.shard import shard_local_update
 
-            ax = cfg.mesh.axis_name
-            batched_update = shard_map(
-                batched_update, mesh=mesh,
-                in_specs=(P(), P(ax), P(ax), P(ax)),
-                out_specs=(P(ax), P(ax), P(ax)),
-                # the pallas_call's ShapeDtypeStructs carry no vma info, so
-                # the varying-across-mesh check can't see through it
-                check_vma=False,
-            )
+            batched_update = shard_local_update(
+                batched_update, mesh, cfg.mesh.axis_name)
     else:
         local_update = build_local_update(
             model, cfg.data_name, train_data,
@@ -231,6 +248,17 @@ def build_round_step(
             compute_dtype=resolve_compute_dtype(cfg.mesh.compute_dtype),
         )
         batched_update = jax.vmap(local_update, in_axes=(None, 0, 0, 0))
+        if mesh is not None and use_shard_map:
+            # mesh-native local epochs (ISSUE 12): each device runs the
+            # vmapped trainer on its own client shard — a collective-free
+            # C/n_dev-client program whose while-loops never see a sharded
+            # operand.  Gated on supports_shard_map at the engine (rbg
+            # hardware keys draw batch-shape-dependent bits; see
+            # parallel/shard module doc).
+            from attackfl_tpu.parallel.shard import shard_local_update
+
+            batched_update = shard_local_update(
+                batched_update, mesh, cfg.mesh.axis_name)
     constrain = constrain or (lambda tree: tree)
 
     drop_rate = cfg.client_dropout_rate
@@ -325,6 +353,18 @@ def build_round_step(
                 sel.reshape((-1,) + (1,) * (n.ndim - 1)), n, p),
             fresh, prev_genuine,
         )
+        if mesh is not None:
+            # canonical output sharding: the leak pool REPLICATES (every
+            # attacker gathers arbitrary rows from it next round, and a
+            # declared placement keeps round 2's input sharding equal to
+            # round 1's — without this the jit re-specializes once per
+            # new input sharding, which the retrace guard flags)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            new_genuine = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, rep),
+                new_genuine)
         keptf = kept.astype(losses.dtype)
         mean_loss = jnp.sum(losses * keptf) / jnp.maximum(jnp.sum(keptf), 1.0)
         return stacked, sizes, new_genuine, train_ok, mean_loss
@@ -348,6 +388,7 @@ def build_aggregator(
     model,
     cfg: Config,
     test_data: Batch | None,
+    mesh=None,
 ) -> Callable:
     """Build ``aggregate(global_params, stacked, sizes, weights_mask, rng)
     -> new_global`` for the configured mode.
@@ -357,8 +398,47 @@ def build_aggregator(
     For "gmm" the reference averages survivors UNWEIGHTED
     (avg_selected_parameters, server.py:777-797); every other weighted mode
     uses sizes.
+
+    With ``mesh`` the aggregation/defense chain becomes in-program
+    collectives over the sharded client axis (ISSUE 12):
+    ``parallel.shard.shard_aggregator`` wraps the same-signature function
+    with psum partial sums or an all_gather, per the defense's needs —
+    FLTrust's root-trust pass runs replicated outside the mapped region
+    and only its combine shards.  The caller gates on
+    ``supports_shard_map``.
     """
     mode = cfg.mode
+    if mesh is not None:
+        from attackfl_tpu.parallel.shard import shard_aggregator
+
+        ax = cfg.mesh.axis_name
+        if mode == "FLTrust":
+            if test_data is None:
+                raise ValueError("FLTrust requires test data for root training")
+            root = {k: jnp.asarray(v[:200]) for k, v in test_data.items()}
+            root_update = build_root_update(
+                model, cfg.data_name, root,
+                epochs=cfg.epochs, batch_size=100, lr=cfg.lr,
+                clip_grad_norm=cfg.clip_grad_norm,
+            )
+            combine = shard_aggregator(None, "FLTrust", mesh, ax)
+
+            def aggregate(global_params, stacked, sizes, weights_mask, rng):
+                # the root pass reads only replicated operands (global
+                # params + rng) — every device computes the identical
+                # trajectory, no collective needed
+                root_params = root_update(global_params, rng)
+                root_delta = jax.tree.map(
+                    lambda a, b: a - b, root_params, global_params)
+                deltas = jax.tree.map(
+                    lambda s, g: s - g[None], stacked, global_params)
+                return combine(global_params, deltas, root_delta, rng)
+        else:
+            plain = build_aggregator(model, cfg, test_data, mesh=None)
+            aggregate = shard_aggregator(plain, mode, mesh, ax)
+        aggregate.telemetry_info = {"program": f"aggregate[{mode}]",
+                                    "sharded": True}
+        return aggregate
     # Geometric modes ignore client weights by construction, but under
     # straggler injection a dropped client's row equals the unchanged
     # broadcast params — an implicit "no change" vote biasing robust

@@ -298,6 +298,67 @@ def test_no_retrace_across_sync_and_pipelined_runs():
 # ---------------------------------------------------------------------------
 
 
+def test_expected_collectives_table_matches_traced_aggregates():
+    """EXPECTED_COLLECTIVES, defense by defense, against the actually
+    traced sharded aggregation chain (jaxpr only — no lowering, no
+    compile, so this is cheap enough for tier-1): psum defenses trace to
+    exactly {psum}, gather defenses to exactly {all_gather}."""
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.parallel.mesh import make_client_mesh
+    from attackfl_tpu.registry import get_model
+    from attackfl_tpu.data.synthetic import get_dataset
+    from attackfl_tpu.training.round import build_aggregator
+
+    ndev = len(jax.devices())
+    cfg0 = audit_config(prng_impl="threefry2x32", total_clients=2 * ndev)
+    model = get_model(cfg0.model)
+    test_np = get_dataset(cfg0.data_name, "test", cfg0.test_size,
+                          cfg0.random_seed)
+    mesh = make_client_mesh()
+    n = cfg0.total_clients
+    rng = jax.random.key(0, impl="threefry2x32")
+    params = model.init(rng, jnp.zeros((1, 7)), jnp.zeros((1, 16)))["params"]
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), params)
+    sizes = jnp.ones((n,), jnp.int32)
+    wmask = jnp.ones((n,), jnp.float32)
+
+    for mode, expected in sorted(
+            program_audit.EXPECTED_COLLECTIVES.items()):
+        agg = build_aggregator(model, cfg0.replace(mode=mode), test_np,
+                               mesh=mesh)
+        jaxpr = jax.make_jaxpr(agg)(params, stacked, sizes, wmask, rng)
+        counts = program_audit.walk_jaxpr(jaxpr)
+        got = set(program_audit.collective_primitives(counts))
+        assert got == set(expected), (mode, got, expected)
+        assert not program_audit.forbidden_primitives(counts), mode
+
+
+@pytest.mark.slow
+def test_sharded_programs_pass_auditor():
+    """The full sharded audit (ISSUE 12 acceptance): every mesh-native
+    program — sync round/aggregate, fused chunk, pipelined step per
+    representative defense, plus the cell-sharded matrix program —
+    passes with its donation aliasing intact through shard_map."""
+    reports = (program_audit.audit_sharded_programs()
+               + program_audit.audit_sharded_matrix_program())
+    assert len(reports) >= 13
+    problems = [(r.name, r.problems) for r in reports if not r.ok]
+    assert not problems, problems
+    # donation really survived shard_map: the fused/pipelined/matrix
+    # programs alias every donated state leaf
+    aliased = [r for r in reports if r.expected_aliases > 0]
+    assert aliased and all(r.aliased_leaves == r.expected_aliases
+                           for r in aliased)
+
+
+@pytest.mark.slow
+def test_sharded_retrace_guard_clean_across_mesh_sizes():
+    from attackfl_tpu.analysis.retrace import sharded_guard_findings
+
+    assert sharded_guard_findings() == []
+
+
 def test_audit_report_fast_path_is_clean():
     report = build_report(skip_programs=True)
     assert report["ok"] is True
@@ -325,7 +386,7 @@ def test_golden_report_format():
     program_keys = {"name", "executor", "ok", "eqns", "distinct_primitives",
                     "forbidden_primitives", "donated_args", "donated_leaves",
                     "expected_aliases", "aliased_leaves", "f64_outputs",
-                    "problems"}
+                    "collectives", "expected_collectives", "problems"}
     for p in golden["programs"]:
         assert set(p) == program_keys
         assert p["ok"] is True

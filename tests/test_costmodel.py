@@ -189,6 +189,28 @@ def test_utilization_summary_roofline_and_achieved_only():
     assert "achieved_flops_per_sec" not in util
 
 
+def test_utilization_divides_by_mesh_devices():
+    """ISSUE 12: on an N-device slice the roofline denominator is N
+    single-chip peaks — utilization divides by the device count so a
+    perfectly-scaled slice cannot report more than a chip's ceiling.
+    Achieved rates stay whole-slice (the scaling-curve quantity)."""
+    programs = {"p": {"flops": 2750, "bytes_accessed": 1228,
+                      "rounds_per_dispatch": 1}}
+    single = utilization_summary(programs, 1e-9, "TPU v4")
+    sliced = utilization_summary(programs, 1e-9, "TPU v4", mesh_devices=4)
+    assert sliced["mesh_devices"] == 4
+    assert sliced["achieved_flops_per_sec"] == \
+        single["achieved_flops_per_sec"]
+    assert sliced["utilization_flops"] == pytest.approx(
+        single["utilization_flops"] / 4)
+    assert sliced["utilization_bytes"] == pytest.approx(0.25)
+    # None / 0 / 1 keep the single-device math byte-for-byte
+    for devices in (None, 0, 1):
+        same = utilization_summary(programs, 1e-9, "TPU v4",
+                                   mesh_devices=devices)
+        assert same == single
+
+
 # ---------------------------------------------------------------------------
 # capture parity across the four executors
 # ---------------------------------------------------------------------------

@@ -30,7 +30,24 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/multihost/events.1.jsonl" in names
     assert "tests/data/events.v3.jsonl" in names
     assert "tests/data/events.v9.jsonl" in names
+    assert "tests/data/events.v10.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
+
+
+def test_v10_mesh_artifact_validates_and_carries_mesh_fields():
+    """The committed v10 corpus (ISSUE 12, from a real 8-device
+    shard_map run): the run_header carries the mesh provenance the
+    ledger's non-peer baseline key mines."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v10.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    header = next(e for e in events if e["kind"] == "run_header")
+    assert header["schema"] == 10
+    assert header["mesh_devices"] == 8
+    assert header["mesh_strategy"] == "shard_map"
 
 
 def test_v1_artifact_stays_green_standalone():

@@ -428,6 +428,59 @@ def test_rolling_baseline_depth_is_a_peer_key():
     assert set(baseline["baseline_of"]) == {"sync-a"}
 
 
+def test_rolling_baseline_mesh_devices_is_a_peer_key():
+    """ISSUE 12 (the depth-key lesson again): mesh size is a placement
+    knob fingerprints don't see, yet throughput is exactly what it
+    changes — an 8-device run must never be gated against 1-device
+    history.  Records predating the field (mesh_devices absent/None)
+    pool with explicitly-meshless (0) records so old baselines keep
+    working."""
+    def record(rid, mesh, rate):
+        out = {"record_id": rid, "fingerprint": "fp", "executor": "fused",
+               "rounds_per_sec_steady": rate}
+        if mesh is not None:
+            out["mesh_devices"] = mesh
+        return out
+
+    records = [record("m1-a", 1, 1.0), record("m1-b", 1, 1.05),
+               record("m8-a", 8, 6.0), record("m8-b", 8, 6.2),
+               record("old-a", None, 0.98), record("none-a", 0, 1.01)]
+    candidate = record("m8-c", 8, 6.1)
+    baseline = rolling_baseline(records + [candidate], candidate)
+    assert set(baseline["baseline_of"]) == {"m8-a", "m8-b"}
+    assert baseline["mesh_devices"] == 8
+    assert baseline["rounds_per_sec_steady"] == 6.1
+    # a regression within the 8-device pool is still caught
+    slow = record("m8-slow", 8, 3.0)
+    verdict = regress_check(rolling_baseline(records + [slow], slow), slow)
+    assert not verdict["ok"]
+    # pre-field (None) and explicit 0 records pool together
+    legacy = record("old-b", None, 1.0)
+    baseline = rolling_baseline(records + [legacy], legacy)
+    assert set(baseline["baseline_of"]) == {"old-a", "none-a"}
+
+
+def test_records_from_bench_mesh_sweep_mapping():
+    """BENCH_MESH.json (the committed mesh-scaling artifact) imports as
+    one record per (device count x workload), each carrying its
+    mesh_devices non-peer key and the parent's speedup column."""
+    parsed = json.load(open(REPO / "BENCH_MESH.json"))
+    records = records_from_bench(parsed)
+    assert len(records) == 8  # 4 device counts x (fused + matrix)
+    for rec in records:
+        assert validate_record(rec) == []
+        assert rec["source"] == "bench"
+        assert isinstance(rec["mesh_devices"], int)
+        assert rec["rounds_per_sec_steady"] > 0
+        assert isinstance(rec["mesh_speedup"], (int, float))
+    by_variant = {r["bench_variant"]: r for r in records}
+    assert by_variant["fused@8dev"]["mesh_devices"] == 8
+    assert by_variant["matrix@1dev"]["executor"] == "matrix"
+    # different device counts never pool into one baseline
+    fused = [r for r in records if r["executor"] == "fused"]
+    assert rolling_baseline(fused, by_variant["fused@8dev"]) is None
+
+
 # ---------------------------------------------------------------------------
 # derivation is pure post-processing (offline, no engine)
 # ---------------------------------------------------------------------------

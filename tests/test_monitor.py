@@ -195,6 +195,37 @@ def test_pipeline_depth_gauge(monitor):
     assert monitor.last_round()["pipeline_depth"] == 0
 
 
+def test_mesh_devices_gauge(monitor):
+    """ISSUE 12: the mesh gauge rides /metrics and /last-round — absent
+    on meshless runs, showing the device count + strategy after the
+    engine reports one at run start."""
+    monitor.run_started()
+    monitor.record_round({"round": 1, "broadcast": 1, "ok": True,
+                          "seconds": 0.1})
+    assert "attackfl_mesh_devices" not in monitor.metrics_text()
+    assert "mesh_devices" not in monitor.last_round()
+    monitor.set_mesh(8, "shard_map")
+    assert "attackfl_mesh_devices 8" in monitor.metrics_text()
+    code, body = get(monitor.port, "/metrics")
+    assert code == 200 and b"attackfl_mesh_devices 8" in body
+    code, body = get(monitor.port, "/last-round")
+    payload = json.loads(body)
+    assert payload["mesh_devices"] == 8
+    assert payload["mesh_strategy"] == "shard_map"
+
+
+def test_watch_prints_mesh(monitor, capsys):
+    from attackfl_tpu import cli
+
+    monitor.run_started()
+    monitor.set_mesh(8, "shard_map")
+    monitor.record_round({"round": 2, "broadcast": 2, "ok": True,
+                          "seconds": 0.1, "roc_auc": 0.7})
+    url = f"http://127.0.0.1:{monitor.port}"
+    assert cli.watch_main([url, "--once"]) == 0
+    assert "mesh=8sm" in capsys.readouterr().out
+
+
 def test_watch_prints_depth_and_degrade(monitor, capsys):
     from attackfl_tpu import cli
 

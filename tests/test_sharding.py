@@ -151,3 +151,138 @@ def test_indivisible_clients_fall_back():
     assert sim.mesh is None  # 5 % 8 != 0 -> replicated fallback
     _, hist = sim.run(save_checkpoints=False, verbose=False)
     assert hist[-1]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# mesh-native (shard_map) execution — ISSUE 12
+# ---------------------------------------------------------------------------
+
+TF = dict(BASE, prng_impl="threefry2x32")
+
+
+def test_constrain_handles_typed_key_trees():
+    """The GSPMD seed-failure regression (training/local.py:165): a
+    sharding constraint on a typed PRNG key array must reach XLA with
+    the PHYSICAL rank of its uint32 key data — jax 0.4.37 builds it from
+    the logical rank and the program fails to partition.  make_constrain
+    now unwraps keys; this must compile and run."""
+    from attackfl_tpu.parallel.mesh import make_client_mesh, make_constrain
+
+    mesh = make_client_mesh()
+    constrain = make_constrain(mesh)
+
+    @jax.jit
+    def prog(rng):
+        keys = constrain(jax.random.split(rng, 16))
+
+        def local(key):
+            def body(carry, ek):
+                return carry + jax.random.normal(ek, (4,)), ()
+            out, _ = jax.lax.scan(body, jnp.zeros((4,)),
+                                  jax.random.split(key, 3))
+            return out
+
+        return jax.vmap(local)(keys)
+
+    out = prog(jax.random.key(0, impl="rbg"))  # rbg: 4-word key data
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_mesh_strategy_auto_rules():
+    """shard_map exactly when the PRNG is bit-stable under re-batching
+    (threefry) on a plain mode; rbg and hyper stay on partitioned GSPMD;
+    forcing shard_map on rbg is an error."""
+    rbg = Config(num_round=1, total_clients=8, mode="fedavg", **BASE)
+    assert Simulator(rbg, use_mesh=True).mesh_strategy == "gspmd"
+    tf = Config(num_round=1, total_clients=8, mode="fedavg", **TF)
+    assert Simulator(tf, use_mesh=True).mesh_strategy == "shard_map"
+    hyper = Config(num_round=1, total_clients=8, mode="hyper", **TF)
+    assert Simulator(hyper, use_mesh=True).mesh_strategy == "gspmd"
+    with pytest.raises(ValueError, match="shard_map"):
+        Simulator(rbg, use_mesh=True, mesh_strategy="shard_map")
+
+
+@pytest.mark.slow
+def test_sharded_aggregators_match_plain_per_defense():
+    """The parallel/shard design table, defense by defense: the
+    shard_map'd aggregation chain must agree with the single-program
+    aggregator on the same stacked data — all_gather modes reassemble
+    the full matrix and are bit-identical; psum modes re-associate the
+    reduction and agree to float tolerance.  (Slow-marked for the tier-1
+    budget; the cheap jaxpr-level collective-table check runs in tier-1
+    via tests/test_analysis.py.)"""
+    from attackfl_tpu.parallel.shard import GATHER_MODES, PSUM_MODES
+    from attackfl_tpu.training.round import build_aggregator
+
+    cfg0 = Config(num_round=1, total_clients=16, mode="fedavg", **TF)
+    sim = Simulator(cfg0)  # borrow its model/test data
+    rng = jax.random.key(7, impl="threefry2x32")
+    k_s, k_agg = jax.random.split(rng)
+    params = sim.init_state()["global_params"]
+    stacked = jax.tree.map(
+        lambda x: x[None] + 0.01 * jax.random.normal(
+            jax.random.fold_in(k_s, x.size), (16,) + x.shape), params)
+    sizes = jnp.arange(1.0, 17.0)
+    wmask = jnp.ones((16,), jnp.float32)
+
+    for mode in sorted(PSUM_MODES | GATHER_MODES):
+        cfg = cfg0.replace(mode=mode)
+        plain = build_aggregator(sim.model, cfg, sim.test_np, mesh=None)
+        sharded = build_aggregator(sim.model, cfg, sim.test_np,
+                                   mesh=sim_mesh())
+        want = jax.jit(plain)(params, stacked, sizes, wmask, k_agg)
+        got = jax.jit(sharded)(params, stacked, sizes, wmask, k_agg)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            if mode in GATHER_MODES:
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=mode)
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=2e-6, rtol=2e-6,
+                    err_msg=mode)
+
+
+def sim_mesh():
+    from attackfl_tpu.parallel.mesh import make_client_mesh
+
+    return make_client_mesh()
+
+
+@pytest.mark.slow
+def test_shard_map_fused_matches_single_device():
+    """run_scan (the fused executor) under shard_map vs the single-
+    program run: training is bit-stable (threefry), so only aggregation
+    reorder + per-shard matmul tiling separate them — the trajectory
+    tolerances of this file apply."""
+    cfg = Config(num_round=2, total_clients=8, mode="fedavg",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2,
+                                     attack_round=2),), **TF)
+    sim_p = Simulator(cfg)
+    state_p, m_p = sim_p.run_scan(sim_p.init_state(), 2)
+    sim_m = Simulator(cfg, use_mesh=True)
+    assert sim_m.mesh_strategy == "shard_map"
+    state_m, m_m = sim_m.run_scan(sim_m.init_state(), 2)
+    np.testing.assert_array_equal(np.asarray(m_p["ok"]),
+                                  np.asarray(m_m["ok"]))
+    assert _max_abs_diff(state_p["global_params"],
+                         state_m["global_params"]) < 5e-3
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0, 2])
+def test_shard_map_pipelined_matches_single_device(depth):
+    """The depth-k pipelined executor over the client mesh: every depth
+    dispatches the one cached sharded step program; params track the
+    single-device sync run within the trajectory tolerance."""
+    cfg = Config(num_round=3, total_clients=8, mode="median",
+                 attacks=(AttackSpec(mode="LIE", num_clients=2,
+                                     attack_round=2),),
+                 pipeline=True, pipeline_depth=depth, **TF)
+    state_p, hist_p = Simulator(cfg.replace(pipeline=False)).run(
+        save_checkpoints=False, verbose=False)
+    sim_m = Simulator(cfg, use_mesh=True)
+    assert sim_m.mesh_strategy == "shard_map"
+    state_m, hist_m = sim_m.run(save_checkpoints=False, verbose=False)
+    assert [h["ok"] for h in hist_p] == [h["ok"] for h in hist_m]
+    assert _max_abs_diff(state_p["global_params"],
+                         state_m["global_params"]) < 5e-3
