@@ -331,6 +331,58 @@ def _watch_backoff(failures: int, interval: float, cap: float = 60.0) -> float:
     return min(interval * (2 ** max(failures - 1, 0)), cap)
 
 
+def _watch_schedule(base: str, args) -> int:
+    """``attackfl-tpu watch --schedule``: poll a run service's
+    ``/schedule`` endpoint (ISSUE 15) — one line per poll with queue
+    depth / predicted backlog / totals, plus a per-job table whenever
+    the queue composition changes.  Unreachable services get the same
+    capped-backoff forgiveness as the monitor poller."""
+    import http.client
+    import urllib.error
+
+    failures = 0
+    last_shape: tuple | None = None
+    while True:
+        try:
+            _, snap = _http_get_json(base + "/schedule")
+        except urllib.error.HTTPError as e:
+            print(f"[watch] /schedule -> http {e.code} "
+                  "(scheduler disabled?)", file=sys.stderr)
+            return 2
+        except (urllib.error.URLError, http.client.HTTPException, OSError,
+                ValueError) as e:
+            failures += 1
+            delay = _watch_backoff(failures, args.interval,
+                                   args.max_backoff)
+            print(f"[watch] {base} unreachable: {e} "
+                  f"(retry {failures} in {delay:.1f}s)", file=sys.stderr)
+            if args.once:
+                return 2
+            time.sleep(delay)
+            continue
+        failures = 0
+        jobs = snap.get("jobs") or []
+        print(f"[watch] sched queue={snap.get('queue_depth')} "
+              f"backlog={snap.get('backlog_seconds', 0):.1f}s "
+              f"max_wait={snap.get('max_wait_seconds', 0):.1f}s "
+              f"preempted={snap.get('preempted_total')} "
+              f"shed={snap.get('shed_total')} "
+              f"broken={snap.get('circuit_broken_total')}", flush=True)
+        shape = tuple((j.get("job_id"), j.get("state")) for j in jobs)
+        if jobs and shape != last_shape:
+            last_shape = shape
+            for job in jobs:
+                print(f"[watch]   {job.get('job_id')} "
+                      f"{job.get('state'):<7} {job.get('priority'):<6} "
+                      f"eff={job.get('effective_priority')} "
+                      f"rem~{job.get('predicted_remaining_seconds')}s "
+                      f"preempts={job.get('preemptions')} "
+                      f"wait={job.get('wait_seconds')}s", flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
 def watch_main(argv=None) -> int:
     """``attackfl-tpu watch``: thin poller of a live run's monitor
     endpoint (``--monitor`` on run/server) — prints each new round as it
@@ -357,8 +409,15 @@ def watch_main(argv=None) -> int:
     parser.add_argument("--once", action="store_true",
                         help="single poll: exit 0 healthy, 1 stalled, "
                              "2 unreachable")
+    parser.add_argument("--schedule", action="store_true",
+                        help="watch a run SERVICE's /schedule endpoint "
+                             "instead: queue depth, backlog vs horizon, "
+                             "per-job effective priorities and "
+                             "preemption/wait accounting")
     args = parser.parse_args(argv)
     base = args.url.rstrip("/")
+    if args.schedule:
+        return _watch_schedule(base, args)
 
     seen_round = object()
     stalled = False

@@ -192,6 +192,17 @@ class ServiceConfig:
     how long a SIGTERM drain waits for in-flight rounds before the
     daemon exits anyway (the queue replay recovers whatever was cut
     short).
+
+    Scheduler knobs (ISSUE 15 — the preemptive multi-tenant scheduler,
+    default ON; ``scheduler=False`` restores the oldest-first loop):
+    ``sched_aging_rate`` is effective-priority points per waiting second
+    (the starvation bound scales as 1/rate); ``sched_min_runtime``
+    protects fresh runs from preemption thrash; ``sched_shed_horizon``
+    > 0 sheds submissions whose predicted backlog exceeds it (429 with
+    a priced retry-after; 0 = never shed); ``sched_breaker_attempts``
+    is the per-job circuit-breaker threshold on persisted crash
+    attempts; ``sched_default_cost`` prices jobs the cost model cannot
+    (cold ledger, malformed profile).
     """
 
     spool_dir: str = ""
@@ -204,6 +215,12 @@ class ServiceConfig:
     worker_backoff_cap: float = 30.0
     run_monitors: bool = True
     drain_grace_seconds: float = 120.0
+    scheduler: bool = True
+    sched_aging_rate: float = 1.0
+    sched_min_runtime: float = 2.0
+    sched_shed_horizon: float = 0.0
+    sched_breaker_attempts: int = 5
+    sched_default_cost: float = 30.0
 
     def __post_init__(self):
         if not 0 <= self.port <= 65535:
@@ -227,6 +244,26 @@ class ServiceConfig:
             raise ValueError(
                 f"service.drain_grace_seconds must be > 0, got "
                 f"{self.drain_grace_seconds}")
+        if self.sched_aging_rate <= 0:
+            raise ValueError(
+                "service.sched_aging_rate must be > 0 (aging is the "
+                f"starvation-freedom mechanism), got {self.sched_aging_rate}")
+        if self.sched_min_runtime < 0:
+            raise ValueError(
+                f"service.sched_min_runtime must be >= 0, got "
+                f"{self.sched_min_runtime}")
+        if self.sched_shed_horizon < 0:
+            raise ValueError(
+                "service.sched_shed_horizon must be >= 0 (0 disables "
+                f"shedding), got {self.sched_shed_horizon}")
+        if self.sched_breaker_attempts < 1:
+            raise ValueError(
+                f"service.sched_breaker_attempts must be >= 1, got "
+                f"{self.sched_breaker_attempts}")
+        if self.sched_default_cost <= 0:
+            raise ValueError(
+                f"service.sched_default_cost must be > 0, got "
+                f"{self.sched_default_cost}")
 
 
 @dataclass(frozen=True)

@@ -194,6 +194,21 @@ def predict_run(records: list[dict[str, Any]], fingerprint: str,
     }
 
 
+def corpus_default_seconds(records: list[dict[str, Any]]
+                           ) -> float | None:
+    """Median measured wall time across the whole corpus — the
+    scheduler's price for an honestly unpredictable job (no fingerprint
+    peer, no static profile).  A corpus-derived default keeps the
+    packer's backlog estimate in the right order of magnitude on warm
+    services; None on an empty/unmeasured corpus (the caller falls back
+    to its configured constant)."""
+    walls = [w for w in (_num(r.get("wall_seconds")) for r in records)
+             if w is not None and w > 0]
+    if not walls:
+        return None
+    return statistics.median(walls)
+
+
 def validate_predictions(records: list[dict[str, Any]],
                          window: int = DEFAULT_WINDOW) -> dict[str, Any]:
     """Leave-one-out replay: predict every measured record from the rest

@@ -241,6 +241,39 @@ class HostFaultInjector:
             return spec.count
         return 0
 
+    # ---- scheduler seams (ISSUE 15) ---------------------------------
+    def preempt_storm_count(self, tick: int) -> int:
+        """Called from the scheduler's dispatch tick WHEN it has running
+        jobs.  An armed ``preempt_storm`` fires once at the first such
+        tick at or after its round and returns how many running jobs to
+        force-preempt; 0 otherwise."""
+        for spec in self._plan:
+            if spec.kind != "preempt_storm" or tick < spec.round:
+                continue
+            key = ("preempt_storm", spec.round)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            self._emit("preempt_storm", tick, count=spec.count)
+            return spec.count
+        return 0
+
+    def estimate_skew_factor(self, seq: int) -> float:
+        """Called per pricing call (``seq`` is the pricer's 1-based call
+        counter).  From an armed ``estimate_skew``'s round onward every
+        price is multiplied by its ``count`` — a PERSISTENT skew (a
+        wrong cost model stays wrong), evented once at first effect."""
+        factor = 1.0
+        for spec in self._plan:
+            if spec.kind != "estimate_skew" or seq < spec.round:
+                continue
+            key = ("estimate_skew", spec.round)
+            if key not in self._fired:
+                self._fired.add(key)
+                self._emit("estimate_skew", seq, factor=spec.count)
+            factor *= float(spec.count)
+        return factor
+
     # ---- monitor seam -----------------------------------------------
     def maybe_stall_monitor(self, round_no: int, monitor) -> None:
         """Rewind the watchdog heartbeat past its threshold so the stall

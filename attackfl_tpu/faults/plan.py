@@ -52,6 +52,18 @@ run-service recovery path is deterministically chaos-testable):
 * ``submit_flood`` — on the ``round``-th submission, inject ``count``
   duplicate submissions: admission control must reject the overflow
   explicitly (a ``job`` event per rejection), never drop it silently.
+
+Scheduler-side (ISSUE 15 — consulted by :mod:`attackfl_tpu.scheduler`):
+
+* ``preempt_storm`` — on the first scheduler tick at or after ``round``
+  that has running jobs, force-preempt up to ``count`` of them (healthy
+  jobs, no priority justification): every victim must checkpoint at its
+  safe seam, requeue, and later resume byte-identical — the chaos gate
+  kills the daemon mid-storm on top of this;
+* ``estimate_skew`` — from the ``round``-th pricing call onward,
+  multiply every cost-model price by ``count``: packing and shed
+  decisions must stay explicit and the service functional when the
+  estimates are badly wrong (the 2x contract's failure mode, amplified).
 """
 
 from __future__ import annotations
@@ -64,7 +76,9 @@ HOST_FAULT_KINDS = (
     "ckpt_write_error", "ckpt_torn", "writer_death", "monitor_stall",
 )
 SERVICE_FAULT_KINDS = ("worker_death", "queue_torn", "submit_flood")
-FAULT_KINDS = DEVICE_FAULT_KINDS + HOST_FAULT_KINDS + SERVICE_FAULT_KINDS
+SCHEDULER_FAULT_KINDS = ("preempt_storm", "estimate_skew")
+FAULT_KINDS = (DEVICE_FAULT_KINDS + HOST_FAULT_KINDS + SERVICE_FAULT_KINDS
+               + SCHEDULER_FAULT_KINDS)
 
 
 @dataclass(frozen=True)
@@ -79,8 +93,10 @@ class FaultSpec:
     ``queue_torn``, the n-th submission for ``submit_flood``).
     ``clients`` selects the target cohort for device-side kinds (empty =
     every client); ``count`` is how many consecutive write attempts fail
-    for ``ckpt_write_error`` and how many duplicate submissions a
-    ``submit_flood`` injects.
+    for ``ckpt_write_error``, how many duplicate submissions a
+    ``submit_flood`` injects, how many running jobs a ``preempt_storm``
+    force-preempts (scheduler tick clock), and the price multiplier an
+    ``estimate_skew`` applies (pricing-call clock).
     """
 
     kind: str
@@ -108,7 +124,8 @@ class FaultSpec:
         out: dict[str, Any] = {"fault": self.kind, "round": self.round}
         if self.clients:
             out["clients"] = list(self.clients)
-        if self.kind in ("ckpt_write_error", "submit_flood"):
+        if self.kind in ("ckpt_write_error", "submit_flood",
+                         "preempt_storm", "estimate_skew"):
             out["count"] = self.count
         return out
 

@@ -285,6 +285,20 @@ def derive_record(events: list[dict[str, Any]],
         mesh_devices = 0
     mesh_strategy = header.get("mesh_strategy")
 
+    # scheduler provenance (ISSUE 15, schema v11): the service's
+    # scheduler stamps priority + preemption/wait accounting into the
+    # run header — mined here so per-job fairness (wait time, preemption
+    # counts by priority class) is answerable from the ledger alone
+    sched_priority = header.get("sched_priority")
+    sched_preemptions = header.get("sched_preemptions")
+    if isinstance(sched_preemptions, bool) \
+            or not isinstance(sched_preemptions, int):
+        sched_preemptions = None
+    sched_wait = header.get("sched_wait_seconds")
+    if isinstance(sched_wait, bool) \
+            or not isinstance(sched_wait, (int, float)):
+        sched_wait = None
+
     programs = profiles_from_events(events) or None
     utilization = None
     if programs:
@@ -311,6 +325,11 @@ def derive_record(events: list[dict[str, Any]],
         "mesh_devices": mesh_devices,
         "mesh_strategy": (str(mesh_strategy)
                           if mesh_strategy is not None else None),
+        "sched_priority": (str(sched_priority)
+                           if sched_priority is not None else None),
+        "sched_preemptions": sched_preemptions,
+        "sched_wait_seconds": (round(sched_wait + 0.0, 6)
+                               if sched_wait is not None else None),
         "resumed": summary.get("resumed_from") is not None,
         "fingerprint": fingerprint,
         "git_rev": str(header.get("git_rev") or ""),
@@ -518,6 +537,25 @@ def records_from_bench(parsed: dict[str, Any]) -> list[dict[str, Any]]:
                     if isinstance(speedups, dict) and key in speedups:
                         record["mesh_speedup"] = speedups[key]
                     records.append(record)
+    elif metric.startswith("fl_contention"):
+        # contention bench (ISSUE 15): scheduler vs serialized dispatch
+        # over the same N-job mixed workload — one record per dispatch
+        # mode so each keeps its own baseline trajectory
+        for variant in ("serialized", "scheduler"):
+            block = detail.get(variant)
+            if not isinstance(block, dict):
+                continue
+            record = _bench_base(parsed, variant, "service")
+            record["wall_seconds"] = block.get("makespan_s_mean")
+            for key in ("mean_wait_s", "throughput_jobs_per_s",
+                        "preemptions", "jobs"):
+                if key in block:
+                    record[key] = block[key]
+            if isinstance(block.get("per_rep"), list):
+                record["per_rep"] = block["per_rep"]
+            if "throughput_ratio" in detail:
+                record["throughput_ratio"] = detail["throughput_ratio"]
+            records.append(record)
     elif metric.startswith("fl_compile_cache"):
         for variant in ("first_run", "warm_cache"):
             block = detail.get(variant)

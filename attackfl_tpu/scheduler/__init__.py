@@ -1,0 +1,38 @@
+"""Preemptive multi-tenant device scheduler (ISSUE 15).
+
+The run service's ``--max-workers`` admission control serialized device
+access: a long matrix sweep starved every job behind it, and overload
+was a blunt queue-depth 429.  This package replaces that with a
+service-level scheduler built from three pieces the repo already
+earned:
+
+* :mod:`.pricing` — every job (run AND matrix sweep) is priced in
+  predicted device-seconds through the PR-11 cost model
+  (fingerprint-peer median first, flops/bytes regression over non-peer
+  records second, an explicit default for honestly unpredictable work);
+* :mod:`.policy` — pure packing/preemption/aging decisions over priced
+  tickets: priority classes with linear aging (sustained high-priority
+  load can never starve a low-priority job — the outrank bound is
+  asserted in tests), cost-ordered packing within a priority band, and
+  preemption ONLY of strictly lower priority classes at the existing
+  safe seams;
+* :mod:`.core` — the daemon-facing :class:`~.core.JobScheduler`: syncs
+  tickets with the durable queue, trips the per-job circuit breaker on
+  crash-looping jobs, sheds load explicitly when the predicted backlog
+  exceeds the horizon, and emits a schema-v11 ``schedule`` event for
+  every decision (admit/pack/preempt/resume/shed/break).
+
+Everything here is jax-free (like :mod:`attackfl_tpu.service.queue`):
+decisions read ledger JSON and spool state only.
+"""
+
+from attackfl_tpu.scheduler.core import JobScheduler, OverloadShedError
+from attackfl_tpu.scheduler.policy import (
+    PRIORITY_CLASSES, SchedulerPolicy, Ticket,
+)
+from attackfl_tpu.scheduler.pricing import JobPricer
+
+__all__ = [
+    "JobScheduler", "OverloadShedError", "JobPricer",
+    "PRIORITY_CLASSES", "SchedulerPolicy", "Ticket",
+]

@@ -67,7 +67,8 @@ def serve_main(argv=None) -> int:
     parser.add_argument("--inject-faults", type=str, default=None,
                         metavar="PLAN",
                         help="service chaos plan (kinds: worker_death "
-                             "queue_torn submit_flood; same grammar as "
+                             "queue_torn submit_flood preempt_storm "
+                             "estimate_skew; same grammar as "
                              "run --inject-faults)")
     parser.add_argument("--no-run-monitors", action="store_true",
                         help="skip the per-run monitor (stall watchdog + "
@@ -81,6 +82,19 @@ def serve_main(argv=None) -> int:
                         help="SIGTERM: how long the drain waits for "
                              "in-flight rounds before exiting anyway "
                              "(the next daemon's replay recovers)")
+    parser.add_argument("--no-scheduler", action="store_true",
+                        help="disable the preemptive scheduler: restore "
+                             "the oldest-first dispatch loop")
+    parser.add_argument("--aging-rate", type=float, default=None,
+                        metavar="PTS_PER_S",
+                        help="scheduler aging: effective-priority points "
+                             "per waiting second (starvation bound "
+                             "scales as 1/rate)")
+    parser.add_argument("--shed-horizon", type=float, default=None,
+                        metavar="SECONDS",
+                        help="shed submissions whose predicted backlog "
+                             "exceeds this (429 + priced retry-after); "
+                             "0 = never shed")
     parser.add_argument("--once", action="store_true",
                         help="exit once the queue is empty and idle "
                              "(batch mode / smoke tests) instead of "
@@ -129,11 +143,20 @@ def serve_main(argv=None) -> int:
                            or os.environ.get("ATTACKFL_COMPILE_CACHE")
                            or cfg.compile_cache_dir),
         base_config=base_raw,
+        scheduler=svc.scheduler and not args.no_scheduler,
+        sched_aging_rate=(svc.sched_aging_rate if args.aging_rate is None
+                          else args.aging_rate),
+        sched_min_runtime=svc.sched_min_runtime,
+        sched_shed_horizon=(svc.sched_shed_horizon
+                            if args.shed_horizon is None
+                            else args.shed_horizon),
+        sched_breaker_attempts=svc.sched_breaker_attempts,
+        sched_default_cost=svc.sched_default_cost,
     )
     service.start()
     print_with_color(
         f"[serve] http://localhost:{service.port} "
-        "(/healthz /jobs /submit /cancel /metrics /runs) — "
+        "(/healthz /jobs /submit /cancel /metrics /runs /schedule) — "
         f"spool {spool} — submit with `attackfl-tpu job submit`", "cyan")
 
     draining = {"flag": False}
@@ -228,6 +251,10 @@ def job_main(argv=None) -> int:
                         help="submit: round-count override")
     parser.add_argument("--name", type=str, default=None,
                         help="submit: human-readable job label")
+    parser.add_argument("--priority", type=str, default=None,
+                        choices=["high", "normal", "low"],
+                        help="submit: scheduler priority class "
+                             "(default normal)")
     parser.add_argument("--timeout", type=int, default=600,
                         help="wait: seconds before giving up (exit 3)")
     parser.add_argument("--interval", type=float, default=0.5,
@@ -246,9 +273,13 @@ def job_main(argv=None) -> int:
             spec["num_rounds"] = args.rounds
         if args.name:
             spec["name"] = args.name
+        if args.priority:
+            spec["priority"] = args.priority
         code, payload = _request(base + "/submit", "POST", spec)
         if code != 200:
-            print(f"submit rejected ({code}): {payload.get('error')}",
+            retry = payload.get("retry_after_seconds")
+            hint = f" (retry in ~{retry}s)" if retry is not None else ""
+            print(f"submit rejected ({code}): {payload.get('error')}{hint}",
                   file=sys.stderr)
             return 1
         print(payload["job_id"])

@@ -13,6 +13,15 @@ that survives its own failures:
   share the persistent compile cache and the device pool) and at most
   ``queue_depth`` live jobs: submission beyond that is an explicit
   HTTP 429 / :class:`~.queue.QueueFullError`, never a silent drop;
+* **preemptive scheduling** (:mod:`attackfl_tpu.scheduler`, ISSUE 15) —
+  dispatch order comes from cost-model bin-packing over priority classes
+  with aging (a starvation bound, not a promise); higher classes preempt
+  at the round/chunk-boundary safe seams and victims resume
+  byte-identical; a configured shed horizon turns predicted overload
+  into priced 429s (``retry_after_seconds``) and crash-looping jobs trip
+  a per-job circuit breaker instead of eating the service.  ``/schedule``
+  exposes the live decision state; every decision is a schema-v11
+  ``schedule`` event;
 * **crash recovery** — kill -9 the daemon, restart it: the queue replay
   requeues whatever was running and the workers resume from each job's
   newest hash-valid checkpoint (the PR-6 ``CheckpointManager`` path), so
@@ -39,6 +48,7 @@ import threading
 import time
 from typing import Any
 
+from attackfl_tpu.scheduler.core import JobScheduler, OverloadShedError
 from attackfl_tpu.service.queue import JobQueue, QueueFullError
 from attackfl_tpu.service.worker import JobWorker
 from attackfl_tpu.telemetry import Counters, EventLog, NullTracer, Telemetry
@@ -61,7 +71,12 @@ class RunService:
                  worker_backoff_cap: float = 30.0, run_monitors: bool = True,
                  fault_plan=(), compile_cache_dir: str = "",
                  base_config: dict[str, Any] | None = None,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05,
+                 scheduler: bool = True, sched_aging_rate: float = 1.0,
+                 sched_min_runtime: float = 2.0,
+                 sched_shed_horizon: float = 0.0,
+                 sched_breaker_attempts: int = 5,
+                 sched_default_cost: float = 30.0):
         self.spool = spool
         os.makedirs(spool, exist_ok=True)
         # default job config: submissions that send no `config` run this
@@ -92,6 +107,21 @@ class RunService:
         self._register_routes()
         self._lock = threading.Lock()
         self._workers: dict[str, JobWorker] = {}
+        # preemptive multi-tenant scheduler (ISSUE 15): cost-model
+        # bin-packing + chunk-boundary preemption + overload shedding.
+        # Default ON — with all-default priorities and a cold ledger it
+        # degenerates to the old oldest-first-up-to-max_workers loop.
+        self.scheduler: JobScheduler | None = None
+        if scheduler:
+            self.scheduler = JobScheduler(
+                self.queue, self.telemetry, self.ledger_dir,
+                slots=self.max_workers, aging_rate=sched_aging_rate,
+                min_runtime_seconds=sched_min_runtime,
+                shed_horizon_seconds=sched_shed_horizon,
+                breaker_attempts=sched_breaker_attempts,
+                default_cost_seconds=sched_default_cost,
+                injector=self._injector, spawn=self._spawn_worker,
+                workers=self._workers_snapshot)
         self._draining = threading.Event()
         self._stopped = threading.Event()
         self._dispatcher: threading.Thread | None = None
@@ -146,12 +176,27 @@ class RunService:
             self._stopped.wait(self.poll_interval)
 
     def _dispatch_once(self) -> None:
+        if self.scheduler is not None:
+            self.scheduler.tick()
+            return
+        # legacy oldest-first dispatch (--no-scheduler)
         with self._lock:
             if len(self._workers) >= self.max_workers:
                 return
         job = self.queue.claim()
         if job is None:
             return
+        self._spawn_worker(job, None)
+
+    def _workers_snapshot(self) -> dict[str, JobWorker]:
+        with self._lock:
+            return dict(self._workers)
+
+    def _spawn_worker(self, job, sched_meta: dict[str, Any] | None) -> None:
+        """One claimed job -> one supervised worker thread.  The
+        scheduler's spawn callback (``sched_meta`` carries priority +
+        preemption/wait accounting into the run header) and the legacy
+        dispatcher both land here."""
         worker = JobWorker(
             job, os.path.join(self.spool, JOBS_DIRNAME, job.job_id),
             self.ledger_dir, self.queue, self.telemetry,
@@ -159,7 +204,8 @@ class RunService:
             backoff_cap=self.worker_backoff_cap,
             run_monitor=self.run_monitors,
             compile_cache_dir=self.compile_cache_dir,
-            injector=self._injector, on_done=self._worker_done)
+            injector=self._injector, sched=sched_meta,
+            on_done=self._worker_done)
         with self._lock:
             self._workers[job.job_id] = worker
         self.telemetry.events.emit(
@@ -234,6 +280,11 @@ class RunService:
             grid_from_dict(dict(spec.get("grid") or {}))
         if not spec.get("config"):
             spec = dict(spec, config=self.base_config)
+        if self.scheduler is not None:
+            # validates the priority class (400 on typos), prices the
+            # job, and raises OverloadShedError (429 + retry-after) when
+            # the predicted backlog is past the shed horizon
+            self.scheduler.admit_check(spec)
         return self.queue.submit(spec)
 
     def cancel(self, job_id: str) -> str:
@@ -300,6 +351,25 @@ class RunService:
             "# TYPE attackfl_service_draining gauge",
             f"attackfl_service_draining {int(self._draining.is_set())}",
         ]
+        if self.scheduler is not None:
+            snap = self.scheduler.snapshot()
+            lines += [
+                "# TYPE attackfl_sched_queue_depth gauge",
+                f"attackfl_sched_queue_depth {snap['queue_depth']}",
+                "# TYPE attackfl_sched_backlog_seconds gauge",
+                f"attackfl_sched_backlog_seconds "
+                f"{snap['backlog_seconds']}",
+                "# TYPE attackfl_sched_max_wait_seconds gauge",
+                f"attackfl_sched_max_wait_seconds "
+                f"{snap['max_wait_seconds']}",
+                "# TYPE attackfl_sched_preempted_total counter",
+                f"attackfl_sched_preempted_total {snap['preempted_total']}",
+                "# TYPE attackfl_sched_shed_total counter",
+                f"attackfl_sched_shed_total {snap['shed_total']}",
+                "# TYPE attackfl_sched_circuit_broken_total counter",
+                f"attackfl_sched_circuit_broken_total "
+                f"{snap['circuit_broken_total']}",
+            ]
         counters = self.telemetry.counters.snapshot()
         if counters:
             lines.append("# TYPE attackfl_counter counter")
@@ -322,9 +392,18 @@ class RunService:
         http.route("POST", "/submit", self._route_submit)
         http.route("POST", "/cancel", self._route_cancel)
         http.route("GET", "/runs", self._route_runs)
+        http.route("GET", "/schedule", self._route_schedule)
 
     def _route_jobs(self, query, body):
         return 200, {"jobs": [j.describe() for j in self.queue.jobs()]}
+
+    def _route_schedule(self, query, body):
+        """The scheduler's live decision state: per-job effective
+        priorities, predicted remaining seconds, preemption/wait
+        accounting, backlog vs shed horizon, the starvation bound."""
+        if self.scheduler is None:
+            return 404, {"error": "scheduler disabled (--no-scheduler)"}
+        return 200, self.scheduler.snapshot()
 
     def _route_status(self, query, body):
         job_id = query.get("job", "")
@@ -347,6 +426,11 @@ class RunService:
             return 400, {"error": "submit body must be a JSON object"}
         try:
             job_id = self.submit(spec)
+        except OverloadShedError as e:
+            # shed: the 429 names WHEN to come back, not just no
+            return 429, {"error": str(e),
+                         "retry_after_seconds": round(
+                             e.retry_after_seconds, 3)}
         except QueueFullError as e:
             return 429, {"error": str(e)}
         except ValueError as e:

@@ -80,7 +80,8 @@ class Job:
             "submitted_ts": self.spec.get("submitted_ts"),
         }
         for key in ("attempts", "resume", "updated_ts", "error", "result",
-                    "monitor_port"):
+                    "monitor_port", "priority", "preemptions",
+                    "wait_seconds", "circuit_broken"):
             if key in self.status:
                 out[key] = self.status[key]
         return out
@@ -122,6 +123,15 @@ class JobQueue:
         if self._tel is not None:
             self._tel.events.emit("job", job_id=job_id, action=action,
                                   **fields)
+
+    @property
+    def version(self) -> int:
+        """Monotone mutation counter — every durable publish (submit,
+        mark, cancel, replay) bumps it.  The scheduler's tick uses it as
+        cheap change detection so a saturated service does not pay a
+        full sealed-entry rescan (read + sha256 per job) at every poll
+        interval while nothing can possibly change."""
+        return self._publish_seq
 
     def _publish_status(self, job_id: str, status: dict[str, Any]) -> None:
         """Atomically republish one job's status (sealed), then offer the
@@ -241,12 +251,16 @@ class JobQueue:
     # transitions
     # ------------------------------------------------------------------
 
-    def claim(self) -> Job | None:
-        """Oldest queued job -> running (the dispatcher's pop).  Returns
-        None when nothing is claimable."""
+    def claim(self, job_id: str | None = None) -> Job | None:
+        """Queued job -> running (the dispatcher's pop): the oldest, or
+        — the scheduler's targeted path — exactly ``job_id``.  Returns
+        None when nothing matching is claimable (e.g. the named job was
+        cancelled between the plan and the claim)."""
         with self._lock:
             for job in self._scan_unlocked():
                 if job.state != "queued":
+                    continue
+                if job_id is not None and job.job_id != job_id:
                     continue
                 job.status = dict(job.status, state="running")
                 job.status.pop("status_torn", None)

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import threading
 import time
 from typing import Any, Callable
@@ -74,9 +75,28 @@ def build_job_config(spec: dict[str, Any], job_dir: str, ledger_dir: str,
     )
 
 
-def backoff_delay(attempt: int, base: float, cap: float) -> float:
-    """Bounded exponential backoff: ``base * 2**(attempt-1)``, capped."""
-    return min(base * (2 ** max(attempt - 1, 0)), cap)
+_BACKOFF_RNG = random.Random()
+
+
+def backoff_delay(attempt: int, base: float, cap: float,
+                  prev: float | None = None,
+                  rng: random.Random | None = None) -> float:
+    """Decorrelated-jitter backoff: ``uniform(base, 3*prev)``, capped.
+
+    N workers crashing on the same cause (a shared bad dependency, a
+    full disk) must NOT retry in lockstep — deterministic exponential
+    backoff synchronizes the herd.  Decorrelated jitter keeps the
+    expected growth exponential while spreading each worker's retries
+    uniformly, and the cap still bounds the worst case.  ``prev`` is the
+    previous delay (None on the first retry, where the spread collapses
+    to ``[base, 3*base]``); ``rng`` is the determinism seam for tests.
+    ``attempt`` stays in the signature so the delay remains a pure
+    function of the retry history the caller already tracks.
+    """
+    del attempt  # growth lives in prev, not in a fixed 2**n schedule
+    rng = rng or _BACKOFF_RNG
+    high = min(max(3.0 * (prev if prev is not None else base), base), cap)
+    return min(rng.uniform(base, high) if high > base else base, cap)
 
 
 class JobWorker(threading.Thread):
@@ -92,6 +112,7 @@ class JobWorker(threading.Thread):
                  telemetry, *, retries: int = 2, backoff: float = 0.5,
                  backoff_cap: float = 30.0, run_monitor: bool = True,
                  compile_cache_dir: str = "", injector=None,
+                 sched: dict[str, Any] | None = None,
                  on_done: Callable | None = None,
                  sleep: Callable[[float], None] = time.sleep):
         super().__init__(name=f"attackfl-worker-{job.job_id}", daemon=True)
@@ -106,10 +127,14 @@ class JobWorker(threading.Thread):
         self.run_monitor = run_monitor
         self.compile_cache_dir = compile_cache_dir
         self._injector = injector
+        # scheduler metadata (ISSUE 15): priority + accounting carried
+        # into the run header so ledger records can mine them
+        self.sched = dict(sched or {})
         self._on_done = on_done
         self._sleep = sleep
         self._drain = threading.Event()
         self._cancel = threading.Event()
+        self._preempt = threading.Event()
         self.sim = None  # live Simulator while a run is in flight
         self.final_state = "running"
         self.error: str | None = None
@@ -123,6 +148,14 @@ class JobWorker(threading.Thread):
     def request_cancel(self) -> None:
         """Finish the in-flight round, mark cancelled."""
         self._cancel.set()
+
+    def request_preempt(self) -> None:
+        """Scheduler preemption (ISSUE 15): stop at the next safe seam
+        (round boundary for runs, chunk boundary for matrix sweeps),
+        checkpoint, requeue with ``resume=True`` — same machinery as
+        drain, but the job goes back to the QUEUE of this daemon rather
+        than the next one's."""
+        self._preempt.set()
 
     # ---- health aggregation (service /healthz) ----------------------
 
@@ -141,17 +174,40 @@ class JobWorker(threading.Thread):
 
     # ---- execution --------------------------------------------------
 
-    def _stop_hook(self, completed_rounds: int) -> bool:
-        """Consulted by the engine between rounds: the drain/cancel seam
-        AND the ``worker_death`` injection point (the injector raises)."""
+    def _stop_hook(self, completed_rounds: int) -> str | bool:
+        """Consulted by the engine between rounds: the drain/cancel/
+        preempt seam AND the ``worker_death`` injection point (the
+        injector raises).  Returns the stop REASON as a truthy string —
+        the engine treats any truthy value as "stop" and threads the
+        reason into its run_end event — or False to keep running."""
         if self._injector is not None:
             self._injector.maybe_worker_death(completed_rounds)
-        return self._drain.is_set() or self._cancel.is_set()
+        if self._cancel.is_set():
+            return "cancel"
+        if self._drain.is_set():
+            return "drain"
+        if self._preempt.is_set():
+            return "preempt"
+        return False
 
     def _emit_job(self, action: str, **fields: Any) -> None:
         if self._tel is not None:
             self._tel.events.emit("job", job_id=self.job.job_id,
                                   action=action, **fields)
+
+    def _sched_header(self) -> dict[str, Any]:
+        """Schema-v11 run-header fields from the scheduler's metadata,
+        so every ledger record derived from this run carries its
+        priority + preemption/wait accounting."""
+        out: dict[str, Any] = {}
+        if self.sched.get("priority"):
+            out["sched_priority"] = str(self.sched["priority"])
+        if self.sched.get("preemptions") is not None:
+            out["sched_preemptions"] = int(self.sched["preemptions"])
+        if self.sched.get("wait_seconds") is not None:
+            out["sched_wait_seconds"] = round(
+                float(self.sched["wait_seconds"]), 6)
+        return out
 
     def _execute(self, resume: bool) -> dict[str, Any]:
         """One attempt: build the isolated config, run to completion or
@@ -167,6 +223,7 @@ class JobWorker(threading.Thread):
             return self._execute_matrix(cfg, resume)
         num_rounds = self.job.spec.get("num_rounds") or cfg.num_round
         sim = Simulator(cfg)
+        sim.header_extra.update(self._sched_header())
         self.sim = sim
         try:
             if sim.monitor is not None:
@@ -205,6 +262,7 @@ class JobWorker(threading.Thread):
         runner = MatrixRun(cfg, grid,
                            sweep_id=self.job.spec.get("sweep_id")
                            or self.job.job_id)
+        runner.header_extra.update(self._sched_header())
         try:
             self.queue.mark(self.job.job_id, "running",
                             sweep_id=runner.sweep_id)
@@ -225,6 +283,7 @@ class JobWorker(threading.Thread):
     def run(self) -> None:  # thread body
         attempts = int(self.job.status.get("attempts", 0))
         resume = bool(self.job.status.get("resume"))
+        prev_delay: float | None = None
         try:
             while True:
                 try:
@@ -244,7 +303,8 @@ class JobWorker(threading.Thread):
                                        error=self.error)
                         return
                     delay = backoff_delay(attempts, self.backoff,
-                                          self.backoff_cap)
+                                          self.backoff_cap, prev=prev_delay)
+                    prev_delay = delay
                     self.queue.mark(self.job.job_id, "running",
                                     attempts=attempts, resume=True,
                                     error=self.error)
@@ -261,6 +321,28 @@ class JobWorker(threading.Thread):
                     if self._tel is not None:
                         self._tel.counters.inc("jobs_cancelled")
                     self._emit_job("cancelled", **_summary(result))
+                    return
+                if result["interrupted"] and self._preempt.is_set() \
+                        and not self._drain.is_set():
+                    # scheduler preemption: checkpointed at the safe
+                    # seam, back to this daemon's queue with the
+                    # preemption count persisted (survives restarts —
+                    # the scheduler rebuilds tickets from status files)
+                    preemptions = int(self.sched.get("preemptions", 0)) + 1
+                    extra: dict[str, Any] = {"preemptions": preemptions}
+                    if self.sched.get("priority"):
+                        extra["priority"] = self.sched["priority"]
+                    if self.sched.get("wait_seconds") is not None:
+                        extra["wait_seconds"] = self.sched["wait_seconds"]
+                    self.final_state = "queued"
+                    self.queue.mark(self.job.job_id, "queued",
+                                    attempts=attempts, resume=True,
+                                    **extra, **_summary(result))
+                    if self._tel is not None:
+                        self._tel.counters.inc("jobs_requeued")
+                    self._emit_job("requeued", reason="preempt",
+                                   preemptions=preemptions,
+                                   **_summary(result))
                     return
                 if result["interrupted"]:  # drain: hand the rest back
                     self.final_state = "queued"

@@ -117,6 +117,22 @@ Schema v10 (ISSUE 12) extends v9 — every v1-v9 file still validates:
   now type-checked when present.  No new kinds: the ledger mines both
   for the ``mesh_devices`` non-peer baseline key.
 
+Schema v11 (ISSUE 15) extends v10 — every v1-v10 file still validates:
+
+* ``schedule`` — one multi-tenant scheduler decision
+  (:mod:`attackfl_tpu.scheduler`): ``action`` =
+  admit/pack/preempt/resume/shed/break, with the decision's evidence
+  riding along as optional typed fields (``job_id``, ``priority``,
+  ``predicted_seconds``, ``backlog_seconds``, ``retry_after_seconds``,
+  ``preemptions``, ``wait_seconds``, ``reason``) — every admit, packing
+  pick, chunk-boundary preemption, resume, load-shed rejection and
+  circuit-breaker trip leaves one auditable record;
+* ``run_header`` MAY carry ``sched_priority`` / ``sched_preemptions`` /
+  ``sched_wait_seconds`` — the scheduler stamps each dispatched run with
+  its priority class, how often it was preempted and how long it waited,
+  and the ledger mines all three (per-job wait/preemption accounting).
+  Type-checked when present; v1-v10 headers carry none of them.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -133,7 +149,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -196,6 +212,21 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # (type-checked below when present): a raising backend analysis
     # degrades to a partial profile instead of killing the run
     "program_profile": {"program": str, "fingerprint": str},
+    # --- schema v11 kind (ISSUE 15) ---
+    # multi-tenant scheduler decision (attackfl_tpu/scheduler): one
+    # record per admit/pack/preempt/resume/shed/break, with the
+    # decision's evidence as optional typed fields (below)
+    "schedule": {"action": str},
+}
+
+# --- schema v11: optional evidence payload on `schedule` events ---
+# (type-checked when present; which fields ride along depends on the
+# action — a shed carries backlog + retry-after, a pack carries the
+# predicted price, a break carries the attempts evidence)
+_OPTIONAL_SCHEDULE_FIELDS: dict[str, Any] = {
+    "job_id": str, "priority": str, "predicted_seconds": _NUM,
+    "backlog_seconds": _NUM, "retry_after_seconds": _NUM,
+    "preemptions": int, "wait_seconds": _NUM, "reason": str,
 }
 
 # --- schema v9: optional cost payload on `program_profile` events ---
@@ -227,6 +258,11 @@ _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     # v10: mesh provenance (ISSUE 12) — the executor's mesh strategy and
     # the device count the ledger's non-peer baseline key reads
     "mesh_strategy": str, "mesh_devices": int,
+    # v11: scheduler provenance (ISSUE 15) — priority class, preemption
+    # count and queue wait the dispatching scheduler stamped on the run;
+    # the ledger mines all three for per-job accounting
+    "sched_priority": str, "sched_preemptions": int,
+    "sched_wait_seconds": _NUM,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -253,6 +289,9 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     # v10 adds no kinds — only the optional run_header mesh fields
     # (ISSUE 12), like v8's pipeline-depth pair
     10: frozenset(),
+    # + optional run_header sched_* fields and the optional evidence
+    # payload on the new kind itself
+    11: frozenset({"schedule"}),
 }
 
 
@@ -360,6 +399,13 @@ def validate_event(record: Any) -> list[str]:
                                        or not isinstance(record[name], typ)):
                     errors.append(
                         f"[program_profile] '{name}' has type "
+                        f"{type(record[name]).__name__}")
+        if kind == "schedule":
+            for name, typ in _OPTIONAL_SCHEDULE_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[schedule] '{name}' has type "
                         f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:

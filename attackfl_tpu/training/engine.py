@@ -298,6 +298,11 @@ class Simulator:
         # with the sweep's `sweep_id` + the cell key (schema v7 optional
         # run_header fields), so cell artifacts join their sweep
         self.header_extra: dict[str, Any] = {}
+        # why the last run stopped early, when the stop hook said so —
+        # hooks may return a truthy REASON string ("drain", "preempt",
+        # "cancel"); recorded on run_end so a preempted run's log says
+        # which seam cut it short (ISSUE 15)
+        self._stop_reason: str | None = None
         # in-graph numerics (ISSUE 4): decided before the round programs
         # are jitted because it changes their donation policy (below)
         self._numerics_on = bool(self.telemetry.enabled
@@ -966,6 +971,21 @@ class Simulator:
                 pass
         return state
 
+    def _consult_stop(self, stop, completed_rounds) -> bool:
+        """One stop-hook consultation, shared by every executor: any
+        truthy verdict stops the run, and a STRING verdict is kept as
+        the stop reason for run_end (the run service's hooks return
+        "drain" / "preempt" / "cancel" so the event log names the seam
+        that cut the run short)."""
+        if stop is None:
+            return False
+        verdict = stop(int(completed_rounds))
+        if not verdict:
+            return False
+        self._stop_reason = (verdict if isinstance(verdict, str)
+                             else "stopped")
+        return True
+
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
@@ -1179,7 +1199,12 @@ class Simulator:
             rounds=len(history),
             ok_rounds=sum(1 for h in history if h.get("ok")),
             seconds=round(time.perf_counter() - t_start, 6),
+            # extra-by-design field: which seam stopped the run early
+            # ("drain" / "preempt" / "cancel"), absent on full runs
+            **({"stop_reason": self._stop_reason}
+               if self._stop_reason else {}),
         )
+        self._stop_reason = None
         tel.flush()
 
     def _append_ledger_record(self) -> None:
@@ -2063,7 +2088,7 @@ class Simulator:
         self._start_monitor()
         try:
             while int(state["completed_rounds"]) < num_rounds:
-                if stop is not None and stop(int(state["completed_rounds"])):
+                if self._consult_stop(stop, state["completed_rounds"]):
                     break
                 remaining = num_rounds - int(state["completed_rounds"])
                 # Chunk sizing doubles as a compile-cache policy: the first
@@ -2401,12 +2426,13 @@ class Simulator:
             configured depth, or 0 while demoted."""
             return 0 if degraded else depth
 
+        stopping = False
         try:
             while completed < num_rounds or queue:
                 # graceful-drain seam: once the hook says stop, dispatch
                 # no new rounds; in-flight ones still resolve (and
                 # checkpoint) below, then the loop exits quiesced
-                stopping = stop is not None and stop(completed)
+                stopping = stopping or self._consult_stop(stop, completed)
                 if stopping and not queue:
                     break
                 want_more = (completed + len(queue) < num_rounds
@@ -2605,7 +2631,7 @@ class Simulator:
         self._start_monitor()
         try:
             while int(state["completed_rounds"]) < num_rounds:
-                if stop is not None and stop(int(state["completed_rounds"])):
+                if self._consult_stop(stop, state["completed_rounds"]):
                     break
                 round_no = int(state["completed_rounds"]) + 1
                 if verbose:

@@ -269,6 +269,13 @@ class MatrixRun:
         # set by run(): True when a stop hook cut the sweep short (the
         # service's requeue signal — byte-identical resume picks it up)
         self.interrupted = False
+        # which seam cut it short ("drain"/"preempt"/"cancel"), when the
+        # stop hook returned a reason string (ISSUE 15)
+        self.stop_reason: str | None = None
+        # extra run_header fields a wrapping service wants recorded —
+        # the scheduler stamps sweeps with sched_priority/preemptions/
+        # wait (schema v11), mirroring the engine's header_extra seam
+        self.header_extra: dict[str, Any] = {}
         # quarantined cells: exceeded the per-cell retry budget (e.g. a
         # NaN-poisoned trajectory that can never recover) — they stop
         # counting toward sweep progress and their records say so, but
@@ -546,6 +553,8 @@ class MatrixRun:
             sweep_id=self.sweep_id,
             grid=self.grid.describe(),
             config=dataclasses.asdict(self.cfg),
+            # schema v11: scheduler metadata when the service runs us
+            **self.header_extra,
         )
 
     def _resolve_chunk(self, metrics: Any, length: int,
@@ -668,7 +677,7 @@ class MatrixRun:
 
         try:
             while self.groups and completed < self.grid.rounds:
-                if stop is not None and stop(completed):
+                if self._consult_stop(stop, completed):
                     interrupted = True
                     break
                 remaining = self.grid.rounds - completed
@@ -783,7 +792,7 @@ class MatrixRun:
         from attackfl_tpu.training.engine import Simulator
 
         for cell in self.fallback_cells:
-            if stop is not None and stop(self.grid.rounds):
+            if self._consult_stop(stop, self.grid.rounds):
                 return True
             os.makedirs(self._cell_dir(cell), exist_ok=True)
             if cell.group == "host":
@@ -796,7 +805,7 @@ class MatrixRun:
                 cell=cell.key, group=cell.group)
             sim = Simulator(self._fallback_config(cell))
             sim.header_extra = {"sweep_id": self.sweep_id,
-                                "cell": cell.key}
+                                "cell": cell.key, **self.header_extra}
             try:
                 if sim.supports_fused():
                     # per-cell specialization: the cell's own compiled
@@ -818,12 +827,30 @@ class MatrixRun:
                 rounds=len(history),
                 ok_rounds=sum(1 for h in history if h.get("ok")))
             if int(state["completed_rounds"]) < self.grid.rounds:
-                return True  # the stop hook cut this cell short
+                # the stop hook cut this cell short mid-run; re-consult
+                # it to capture the reason (the hook is a level check —
+                # drain/preempt/cancel events stay set once raised)
+                self._consult_stop(stop, int(state["completed_rounds"]))
+                return True
         return False
 
     # ------------------------------------------------------------------
     # terminal work
     # ------------------------------------------------------------------
+
+    def _consult_stop(self, stop, completed) -> bool:
+        """One stop-hook consultation (the engine's rule): any truthy
+        verdict stops the sweep at this chunk/cell boundary, and a
+        STRING verdict is kept as :attr:`stop_reason` so the sweep's
+        ``interrupted`` event names the seam (drain/preempt/cancel)."""
+        if stop is None:
+            return False
+        verdict = stop(int(completed))
+        if not verdict:
+            return False
+        self.stop_reason = (verdict if isinstance(verdict, str)
+                            else "stopped")
+        return True
 
     def _finish(self, histories: dict[str, list[dict[str, Any]]],
                 t_start: float, interrupted: bool) -> None:
@@ -834,7 +861,9 @@ class MatrixRun:
             tel.events.emit(
                 "matrix", sweep_id=self.sweep_id,
                 action="interrupted" if interrupted else "completed",
-                cells_done=len(histories), seconds=round(wall, 6))
+                cells_done=len(histories), seconds=round(wall, 6),
+                **({"stop_reason": self.stop_reason}
+                   if interrupted and self.stop_reason else {}))
             tel.events.emit("counters", counters=tel.counters.snapshot())
             total = sum(len(h) for h in histories.values())
             tel.events.emit(

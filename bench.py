@@ -701,6 +701,238 @@ def measure_matrix_compare(rounds: int, log_path: str, reps: int = 2,
     return out
 
 
+def measure_contention(log_path: str, jobs: int = 6, reps: int = 2) -> dict:
+    """Multi-tenant contention bench (ISSUE 15): the SAME N-job mixed
+    workload burst-submitted to an in-process RunService under the
+    preemptive scheduler vs the legacy serialized (oldest-first)
+    dispatch, one device slot each.
+
+    Protocol (the alternating-order paired-means discipline of
+    --matrix-compare): an untimed warmup batch first — it absorbs the
+    one-off compiles AND seeds a ledger whose records give the packer
+    real fingerprint-peer prices — then ``reps`` timed rep pairs with
+    the variant order alternating per rep.  The workload is adversarial
+    for FIFO on purpose: long low-priority jobs submitted FIRST, short
+    high-priority jobs behind them, so serialized dispatch convoys the
+    shorts while the scheduler's band-then-SJF order services them
+    early.  Headline = scheduler throughput; vs_baseline = ratio over
+    serialized (same jobs, same slot — it must not be < 1 beyond
+    noise, because with no mid-run preemption the batch is
+    work-conserving either way).  The detail carries the packer's
+    accuracy evidence: leave-one-out ``validate_predictions`` over the
+    rep ledger plus per-job predicted-vs-measured factors (the 2x
+    cost-validate contract the scheduler's decisions lean on)."""
+    import os
+    import statistics
+
+    from attackfl_tpu.service.daemon import RunService
+
+    root = os.path.join(log_path, "contention")
+    if os.path.isdir(root):
+        import shutil
+
+        shutil.rmtree(root)
+    os.makedirs(root, exist_ok=True)
+    # one shape for every job (shared compile), rounds/priority mixed;
+    # submission order = longest+lowest first (FIFO's worst case)
+    config = {
+        "server": {
+            "num-round": 2, "clients": 3, "mode": "fedavg",
+            "model": "CNNModel", "data-name": "ICU", "validation": False,
+            "train-size": 256, "test-size": 128, "random-seed": 1,
+            "data-distribution": {"num-data-range": [48, 64]},
+        },
+        "learning": {"epoch": 1, "batch-size": 32},
+    }
+    # rounds sized so training dominates the ~3s/job fixed trace +
+    # cache-load overhead (which is order-invariant noise both variants
+    # pay identically)
+    rounds_pattern = [8, 2, 5, 2, 8, 5]
+    priority_pattern = ["low", "high", "normal", "high", "low", "normal"]
+    specs = [{"config": config, "num_rounds": rounds_pattern[i % 6],
+              "name": f"contend-{i}", "priority": priority_pattern[i % 6]}
+             for i in range(jobs)]
+    # ... plus ONE matrix sweep riding the same queue (the satellite's
+    # "runs + one sweep" mixed workload): 2 cells, priced per-cell
+    grid = {"attacks": ["LIE"], "attack-clients": 1, "attack-round": 2,
+            "defenses": ["fedavg", "median"], "seeds": [1], "rounds": 4}
+    sweep_spec = {"type": "matrix", "name": "contend-sweep",
+                  "priority": "normal", "config": config, "grid": grid}
+    specs.insert(min(3, len(specs)), dict(sweep_spec))
+
+    def job_events(spool: str) -> dict[str, dict[str, float]]:
+        """job_id -> {submitted: ts, started: ts (first)} from the
+        service event stream — wait is identical bookkeeping for both
+        variants (same queue, same spawn path)."""
+        stamps: dict[str, dict[str, float]] = {}
+        with open(os.path.join(spool, "service.events.jsonl")) as fh:
+            for line in fh:
+                event = json.loads(line)
+                if event.get("kind") != "job":
+                    continue
+                per = stamps.setdefault(event.get("job_id", ""), {})
+                action = event.get("action")
+                if action in ("submitted", "started") and action not in per:
+                    per[action] = event["ts"]
+                if action == "completed":
+                    per[action] = event["ts"]  # last one wins (resume)
+        return stamps
+
+    def run_batch(variant: str, tag: str, seed_ledger: str | None,
+                  batch: list[dict]) -> dict:
+        spool = os.path.join(root, f"{variant}-{tag}")
+        if seed_ledger and os.path.isdir(seed_ledger):
+            import shutil
+
+            shutil.copytree(seed_ledger, os.path.join(spool, "ledger"))
+        svc = RunService(spool, port=0, max_workers=1, run_monitors=False,
+                         poll_interval=0.02, worker_backoff=0.05,
+                         worker_backoff_cap=0.2,
+                         scheduler=(variant == "scheduler"))
+        try:
+            ids = [svc.submit(dict(spec)) for spec in batch]
+            t0 = time.perf_counter()
+            svc.start()
+            deadline = t0 + 900.0
+            while time.perf_counter() < deadline:
+                # one queue scan per poll, coarse interval: queue.get()
+                # is a full sealed-entry rescan, and a hot poll loop
+                # steals CPU from the single-core training it measures
+                snapshot = {j.job_id: j.state for j in svc.queue.jobs()}
+                states = {i: snapshot.get(i, "unknown") for i in ids}
+                if all(s == "done" for s in states.values()):
+                    break
+                if any(s in ("failed", "cancelled") for s in states.values()):
+                    raise RuntimeError(f"contention job died: {states}")
+                time.sleep(0.2)
+            else:
+                raise RuntimeError("contention batch timed out")
+            makespan = time.perf_counter() - t0
+            preemptions = sum(
+                int((svc.queue.get(i).status or {}).get("preemptions", 0))
+                for i in ids)
+        finally:
+            svc.drain(timeout=10.0)
+            svc.close()
+        stamps = job_events(spool)
+        waits = {i: stamps[i]["started"] - stamps[i]["submitted"]
+                 for i in ids if "started" in stamps.get(i, {})}
+        # total in-worker execution time: makespan - service_s is the
+        # dispatch overhead the variants actually differ by
+        service = sum(s["completed"] - s["started"] for s in stamps.values()
+                      if "completed" in s and "started" in s)
+        by_priority: dict[str, list[float]] = {}
+        for i, spec in zip(ids, batch):
+            if i in waits:
+                by_priority.setdefault(spec["priority"], []).append(waits[i])
+        return {
+            "spool": spool, "makespan_s": round(makespan, 3),
+            "service_s": round(service, 3),
+            "mean_wait_s": round(statistics.mean(waits.values()), 3),
+            "wait_by_priority": {p: round(statistics.mean(v), 3)
+                                 for p, v in sorted(by_priority.items())},
+            "preemptions": preemptions,
+        }
+
+    # untimed warmup: compiles + a seeded ledger (fingerprint peers for
+    # the packer's "peer" pricing method in the timed scheduler reps)
+    warm = run_batch("scheduler", "warmup", None,
+                     [{"config": config, "num_rounds": 1,
+                       "name": "contend-warmup", "priority": "normal"},
+                      dict(sweep_spec, name="contend-warmup-sweep")])
+    seed_ledger = os.path.join(warm["spool"], "ledger")
+
+    per_variant: dict[str, list[dict]] = {"serialized": [], "scheduler": []}
+    for rep in range(reps):
+        order = ["serialized", "scheduler"]
+        for variant in (order if rep % 2 == 0 else reversed(order)):
+            per_variant[variant].append(
+                run_batch(variant, f"rep{rep}", seed_ledger, specs))
+
+    def mean(values: list[float]) -> float:
+        return round(sum(values) / len(values), 3)
+
+    total = len(specs)
+    out: dict = {
+        "config": f"contention: {jobs} runs (rounds "
+                  f"{rounds_pattern[:jobs]}, priorities "
+                  f"{priority_pattern[:jobs]}) + 1 matrix sweep "
+                  f"({len(grid['defenses'])} cells), 1 slot, "
+                  f"{reps} rep(s)",
+        "jobs": total, "reps": reps,
+    }
+    for variant, rows in per_variant.items():
+        makespans = [r["makespan_s"] for r in rows]
+        out[variant] = {
+            "makespan_s_mean": mean(makespans),
+            "service_s_mean": mean([r["service_s"] for r in rows]),
+            "mean_wait_s": mean([r["mean_wait_s"] for r in rows]),
+            "wait_by_priority": rows[-1]["wait_by_priority"],
+            "preemptions": sum(r["preemptions"] for r in rows),
+            "jobs": total,
+            "per_rep": makespans,
+            "throughput_jobs_per_s": round(total / mean(makespans), 4),
+        }
+    out["throughput_ratio"] = round(
+        out["scheduler"]["throughput_jobs_per_s"]
+        / out["serialized"]["throughput_jobs_per_s"], 4)
+    out["wait_ratio"] = round(
+        out["scheduler"]["mean_wait_s"]
+        / max(out["serialized"]["mean_wait_s"], 1e-9), 4)
+
+    # the packer's accuracy contract: replay the last scheduler rep's
+    # ledger through leave-one-out validation, and price each submitted
+    # spec against its measured wall (records matched by round count —
+    # every job of one length is the same program here)
+    from attackfl_tpu.costmodel.estimate import validate_predictions
+    from attackfl_tpu.ledger.store import LedgerStore
+    from attackfl_tpu.scheduler.pricing import JobPricer
+
+    last_spool = per_variant["scheduler"][-1]["spool"]
+    records, _ = LedgerStore(os.path.join(last_spool, "ledger")).load()
+    validation = validate_predictions(records)
+    validation.pop("rows", None)  # summary only; rows are per-record noise
+    pricer = JobPricer(os.path.join(warm["spool"], "ledger"))
+    seeded_ids = {r.get("record_id")
+                  for r in LedgerStore(seed_ledger).load()[0]}
+    fresh = [r for r in records if r.get("record_id") not in seeded_ids
+             and not r.get("cell")]  # per-cell sweep records priced apart
+    per_job = []
+    for spec in specs:
+        if spec.get("type") == "matrix":
+            continue
+        priced = pricer.price(spec)
+        measured = [r.get("wall_seconds") for r in fresh
+                    if r.get("rounds") == spec["num_rounds"]
+                    and isinstance(r.get("wall_seconds"), (int, float))]
+        if not measured:
+            continue
+        actual = statistics.median(measured)
+        factor = max(priced["predicted_seconds"] / actual,
+                     actual / priced["predicted_seconds"])
+        per_job.append({"name": spec["name"],
+                        "rounds": spec["num_rounds"],
+                        "method": priced["method"],
+                        "predicted_s": round(priced["predicted_seconds"], 3),
+                        "measured_s": round(actual, 3),
+                        "error_factor": round(factor, 3)})
+    factors = [row["error_factor"] for row in per_job]
+    sweep_price = pricer.price(sweep_spec)
+    sweep_walls = [r.get("wall_seconds") for r in records
+                   if r.get("record_id") not in seeded_ids and r.get("cell")
+                   and isinstance(r.get("wall_seconds"), (int, float))]
+    if sweep_walls:
+        sweep_price["measured_s"] = round(sum(sweep_walls), 3)
+    out["cost_contract"] = {
+        "leave_one_out": validation,
+        "per_job": per_job,
+        "sweep": sweep_price,
+        "worst_job_factor": round(max(factors), 3) if factors else None,
+        "within_2x": bool(factors) and max(factors) <= 2.0,
+    }
+    return out
+
+
 def mesh_sweep_config(log_path: str = "/tmp/attackfl_bench"):
     """The mesh-sweep workload: 64-client ICU Transformer under FedAvg
     with LIE attackers and threefry keys (the shard_map gate — rbg
@@ -961,6 +1193,18 @@ def main() -> None:
                              "batched scenario-matrix program (5 attacks x "
                              "9 defenses, cold + warm walls, paired means; "
                              "--rounds rounds per cell)")
+    parser.add_argument("--contention", action="store_true",
+                        help="measure ONLY the multi-tenant contention "
+                             "bench: a 6-job mixed-priority workload "
+                             "burst-submitted to the preemptive "
+                             "scheduler vs serialized oldest-first "
+                             "dispatch (one slot, alternating-order "
+                             "paired means, packer cost-contract "
+                             "evidence in the detail)")
+    parser.add_argument("--contention-jobs", type=int, default=6,
+                        help="jobs per batch for --contention")
+    parser.add_argument("--contention-reps", type=int, default=3,
+                        help="timed rep pairs for --contention")
     parser.add_argument("--matrix-seeds", type=int, default=1,
                         help="seeds per cell for --matrix-compare")
     parser.add_argument("--compile-cache", nargs="?", type=str, default=None,
@@ -990,16 +1234,17 @@ def main() -> None:
                       args.north_star, args.e2e_rounds is not None,
                       args.pipeline_compare, args.numerics_overhead,
                       args.depth_sweep, args.matrix_compare,
-                      args.mesh_sweep,
+                      args.mesh_sweep, args.contention,
                       args.compile_cache is not None))) > 1:
         parser.error("--config / --north-star / --e2e-rounds / "
                      "--pipeline-compare / --numerics-overhead / "
                      "--depth-sweep / --matrix-compare / --mesh-sweep / "
-                     "--compile-cache are exclusive")
+                     "--contention / --compile-cache are exclusive")
     single = (args.config is not None or args.north_star
               or args.e2e_rounds is not None or args.pipeline_compare
               or args.numerics_overhead or args.depth_sweep
               or args.matrix_compare or args.mesh_sweep
+              or args.contention
               or args.compile_cache is not None)
     if not single and (args.backend or args.clients or args.trace or args.dtype
                        or args.hyper_update):
@@ -1024,6 +1269,8 @@ def main() -> None:
         metric_name = "fl_depth_sweep_rounds_per_sec"
     elif args.matrix_compare:
         metric_name = "fl_matrix_vs_serial_sweep"
+    elif args.contention:
+        metric_name = "fl_contention_sched_vs_serial"
     elif args.mesh_sweep:
         metric_name = "fl_mesh_sweep_scaling"
     elif args.compile_cache is not None:
@@ -1143,6 +1390,23 @@ def main() -> None:
             metric_name, res["fused_speedup"][top], unit="x",
             matrix_speedup=res["matrix_speedup"][top],
             devices=res["device_counts"],
+            detail=res,
+        )
+        ledger_append(line)
+        print(json.dumps(line))
+        return
+
+    if args.contention:
+        deadline_timer.cancel()
+        res = measure_contention("/tmp/attackfl_bench",
+                                 jobs=args.contention_jobs,
+                                 reps=args.contention_reps)
+        partial.update(res)
+        line = metric_line(
+            metric_name, res["scheduler"]["throughput_jobs_per_s"],
+            unit="jobs/s",
+            vs_baseline=res["throughput_ratio"],
+            wait_ratio=res["wait_ratio"],
             detail=res,
         )
         ledger_append(line)

@@ -1,0 +1,117 @@
+#!/usr/bin/env bash
+# One-shot scheduler smoke gate (ISSUE 15 satellite), the sibling of
+# scripts/service_smoke.sh: boots a REAL `attackfl-tpu serve` daemon,
+# submits a low-priority multi-round job plus two high-priority jobs
+# while it runs, and asserts the preemptive scheduler did its job end to
+# end — the low job is preempted at a round boundary (a `schedule`
+# preempt event), resumed (a `schedule` resume event), ALL jobs finish
+# `done`, and the shared ledger's records carry the preemption
+# provenance (sched_priority / sched_preemptions mined from the run
+# header).  Used by tier-1 through tests/test_scheduler.py; run it
+# directly before sending a PR.
+#
+# Usage: scripts/sched_smoke.sh [spool-dir]   (default: a fresh tmp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# share the persistent compile cache so repeat smokes skip the compile
+export ATTACKFL_COMPILE_CACHE="${ATTACKFL_COMPILE_CACHE:-/tmp/attackfl_jax_cache}"
+
+SPOOL="${1:-$(mktemp -d /tmp/attackfl_sched_smoke.XXXXXX)}"
+LOW_CFG="$SPOOL/low.yaml"
+HIGH_CFG="$SPOOL/high.yaml"
+cat > "$LOW_CFG" <<'YAML'
+server:
+  num-round: 6
+  clients: 3
+  mode: fedavg
+  model: CNNModel
+  data-name: ICU
+  validation: false
+  train-size: 256
+  test-size: 128
+  random-seed: 1
+  data-distribution:
+    num-data-range: [48, 64]
+learning:
+  epoch: 1
+  batch-size: 32
+YAML
+# same shapes (shared compile cache), different seed + 1 round: the
+# high-priority jobs are short so the preempted job resumes quickly
+sed -e 's/num-round: 6/num-round: 1/' -e 's/random-seed: 1/random-seed: 2/' \
+    "$LOW_CFG" > "$HIGH_CFG"
+
+python -m attackfl_tpu serve --spool "$SPOOL" --port 0 \
+    --worker-backoff 0.2 &
+SERVE_PID=$!
+cleanup() { kill -9 "$SERVE_PID" 2>/dev/null || true; }
+trap cleanup EXIT
+
+echo "--- waiting for the control plane (spool: $SPOOL)"
+for _ in $(seq 1 150); do
+    [ -f "$SPOOL/service.json" ] && break
+    sleep 0.2
+done
+[ -f "$SPOOL/service.json" ] || { echo "service never came up" >&2; exit 1; }
+
+echo "--- submit: 1 low-priority (6 rounds) + 2 high-priority (1 round)"
+LOW=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+      --config "$LOW_CFG" --name smoke-low --priority low)
+echo "low job: $LOW"
+# let the low job actually occupy the slot (and outlive the scheduler's
+# min-runtime anti-thrash guard) before the high jobs contend for it
+for _ in $(seq 1 300); do
+    STATE=$(python -m attackfl_tpu job status "$LOW" --spool "$SPOOL" \
+            | python -c 'import json,sys; print(json.load(sys.stdin)["state"])')
+    [ "$STATE" = "running" ] && break
+    sleep 0.2
+done
+[ "$STATE" = "running" ] || { echo "low job never started" >&2; exit 1; }
+sleep 2
+HIGH1=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+        --config "$HIGH_CFG" --name smoke-high-1 --priority high)
+HIGH2=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+        --config "$HIGH_CFG" --name smoke-high-2 --priority high)
+echo "high jobs: $HIGH1 $HIGH2"
+
+echo "--- wait for all three (the low job must survive its preemption)"
+python -m attackfl_tpu job wait "$HIGH1" --spool "$SPOOL" --timeout 300
+python -m attackfl_tpu job wait "$HIGH2" --spool "$SPOOL" --timeout 300
+python -m attackfl_tpu job wait "$LOW" --spool "$SPOOL" --timeout 300
+
+echo "--- scheduler evidence: preempt + resume events, ledger provenance"
+python - "$SPOOL" "$LOW" <<'PY'
+import json
+import sys
+
+spool, low = sys.argv[1], sys.argv[2]
+events = [json.loads(line)
+          for line in open(spool + "/service.events.jsonl")]
+schedule = [e for e in events if e["kind"] == "schedule"]
+actions = [e["action"] for e in schedule]
+assert actions.count("admit") >= 3, actions
+preempts = [e for e in schedule if e["action"] == "preempt"]
+assert any(e.get("job_id") == low for e in preempts), \
+    f"low job was never preempted: {actions}"
+resumes = [e for e in schedule if e["action"] == "resume"]
+assert any(e.get("job_id") == low for e in resumes), \
+    f"low job was never resumed: {actions}"
+
+from attackfl_tpu.ledger.store import LedgerStore
+
+records, _ = LedgerStore(spool + "/ledger").load()
+assert len(records) >= 3, f"expected >=3 ledger records, got {len(records)}"
+mined = [r for r in records if r.get("sched_preemptions")]
+assert mined, "no ledger record carries a preemption count"
+assert any(r.get("sched_priority") == "low" for r in mined), mined
+print(f"schedule events: {len(schedule)} "
+      f"(preempts: {len(preempts)}, resumes: {len(resumes)}); "
+      f"ledger records: {len(records)}, with preemptions: {len(mined)}")
+PY
+
+echo "--- SIGTERM drain -> clean exit"
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+trap - EXIT
+echo "sched smoke: OK"

@@ -31,6 +31,7 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/events.v3.jsonl" in names
     assert "tests/data/events.v9.jsonl" in names
     assert "tests/data/events.v10.jsonl" in names
+    assert "tests/data/events.v11.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 
@@ -95,3 +96,29 @@ def test_v9_costmodel_artifact_validates_standalone():
         assert event["memory"]["peak"] > 0
         assert event["rounds_per_dispatch"] >= 1
         assert isinstance(event["device_kind"], str)
+
+
+def test_v11_scheduler_artifact_validates_standalone():
+    """The committed v11 corpus (ISSUE 15, from a real sched_smoke
+    session): `schedule` decision events validate, the preempted run's
+    header carries the sched_* provenance the ledger mines, and the
+    preempted segment's run_end records why it stopped."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v11.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    schedule = [e for e in events if e["kind"] == "schedule"]
+    actions = {e["action"] for e in schedule}
+    assert {"admit", "pack", "preempt", "resume"} <= actions, actions
+    for event in schedule:
+        assert event["schema"] == 11
+        assert isinstance(event["action"], str)
+    headers = [e for e in events if e["kind"] == "run_header"
+               and "sched_priority" in e]
+    assert headers, "v11 corpus must carry sched_* run-header provenance"
+    assert any(e["sched_preemptions"] >= 1 for e in headers)
+    assert all(isinstance(e["sched_wait_seconds"], float) for e in headers)
+    ends = [e for e in events if e["kind"] == "run_end"]
+    assert any(e.get("stop_reason") == "preempt" for e in ends)

@@ -1,0 +1,1010 @@
+"""Preemptive multi-tenant scheduler (ISSUE 15).
+
+Three layers, mirroring the package:
+
+* **policy** — pure decisions with a fake clock: band/SJF/FIFO queue
+  order, unbounded aging (the starvation-freedom bound), class-only
+  preemption behind the min-runtime anti-thrash guard, priced shedding;
+* **pricing** — spec -> predicted seconds through the PR-11 cost model
+  (peer median, corpus median, explicit default) plus the
+  ``estimate_skew`` chaos seam;
+* **core + service** — tickets rebuilt from the durable queue, the
+  per-job circuit breaker, and the full preempt -> requeue -> resume
+  cycle against a real :class:`RunService` (fast with a stubbed
+  executor; slow-marked with real jobs, asserting byte-identical
+  checkpoints against an uninterrupted reference).
+
+The slow tier also covers the engine/matrix stop-reason plumbing
+(``run_end.stop_reason`` / the matrix ``interrupted`` event) and the
+chaos gate: kill -9 a real daemon mid-``preempt_storm`` with a mixed
+run + matrix workload and assert every final artifact is byte-identical
+after restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import time
+
+import pytest
+
+from attackfl_tpu.faults.plan import parse_fault_plan
+from attackfl_tpu.scheduler import (
+    JobPricer, JobScheduler, OverloadShedError, PRIORITY_CLASSES,
+    SchedulerPolicy, Ticket,
+)
+from attackfl_tpu.scheduler.policy import priority_base
+from attackfl_tpu.service.queue import JobQueue
+from attackfl_tpu.telemetry import Counters, EventLog, NullTracer, Telemetry
+
+from tests.test_service import (
+    REPO, _daemon_cmd, _daemon_env, _http, _wait_daemon, job_config,
+    make_service, reference_run, wait_for,
+)
+
+
+# ---------------------------------------------------------------------------
+# policy: pure decisions, fake clock
+# ---------------------------------------------------------------------------
+
+def _ticket(job_id, priority="normal", predicted=10.0, enq=0.0, seq=0,
+            **kw):
+    return Ticket(job_id=job_id, priority=priority,
+                  predicted_seconds=predicted, enqueued_ts=enq, seq=seq,
+                  **kw)
+
+
+def test_priority_classes_and_validation():
+    assert priority_base("high") > priority_base("normal") \
+        > priority_base("low")
+    with pytest.raises(ValueError, match="unknown priority"):
+        priority_base("urgent")
+    with pytest.raises(ValueError, match="aging_rate"):
+        SchedulerPolicy(aging_rate=0.0)
+
+
+def test_queue_order_band_then_sjf_then_fifo():
+    policy = SchedulerPolicy(slots=1, aging_rate=1.0)
+    high = _ticket("h", "high", predicted=50.0, seq=3)
+    norm_short = _ticket("ns", "normal", predicted=5.0, seq=1)
+    norm_long = _ticket("nl", "normal", predicted=40.0, seq=0)
+    low = _ticket("l", "low", predicted=1.0, seq=2)
+    order = policy._queue_order([low, norm_long, norm_short, high], now=0.0)
+    # class band first; inside the normal band the cost model packs
+    # shortest-first regardless of submission order
+    assert [t.job_id for t in order] == ["h", "ns", "nl", "l"]
+    # equal class + equal price -> FIFO (enqueue time, then seq): the
+    # all-defaults degeneration that keeps the old service semantics
+    a = _ticket("a", predicted=10.0, enq=1.0, seq=0)
+    b = _ticket("b", predicted=10.0, enq=2.0, seq=1)
+    assert [t.job_id for t in policy._queue_order([b, a], now=3.0)] \
+        == ["a", "b"]
+
+
+def test_unbounded_aging_outranks_within_the_starvation_bound():
+    policy = SchedulerPolicy(slots=1, aging_rate=1.0)
+    bound = policy.starvation_bound_seconds()
+    bases = PRIORITY_CLASSES.values()
+    assert bound == (max(bases) - min(bases) + policy.band_width) \
+        / policy.aging_rate
+    low = _ticket("old-low", "low", enq=0.0)
+    # just before the bound a fresh high still wins the band...
+    fresh = _ticket("fresh-high", "high", enq=bound - 2 * policy.band_width)
+    now = bound - policy.band_width
+    assert policy._queue_order([low, fresh], now)[0].job_id == "fresh-high"
+    # ...at the bound the aged low STRICTLY outranks any high submitted
+    # at decision time: finite work ahead of it, so it eventually runs
+    assert policy.effective_priority(low, bound) \
+        >= priority_base("high") + policy.band_width
+    assert policy._queue_order(
+        [low, _ticket("new-high", "high", enq=bound)], bound
+    )[0].job_id == "old-low"
+
+
+def test_plan_packs_free_slots_shortest_first():
+    policy = SchedulerPolicy(slots=2, aging_rate=1.0)
+    queued = [_ticket("big", predicted=100.0, seq=0),
+              _ticket("small", predicted=1.0, seq=1)]
+    plan = policy.plan(queued, [], now=0.0)
+    assert [t.job_id for t in plan.start] == ["small", "big"]
+    assert plan.preempt == []
+    # backlog = total predicted seconds over the slot budget
+    assert plan.backlog_seconds == pytest.approx(101.0 / 2)
+
+
+def test_preemption_is_class_only_and_guarded():
+    policy = SchedulerPolicy(slots=1, aging_rate=1.0,
+                             min_runtime_seconds=2.0)
+    running = [_ticket("victim", "normal", predicted=100.0, started_ts=0.0)]
+    # an AGED low ticket outranks any fresh class by band, but its CLASS
+    # is not higher: aging promotes queue order only, never preemption
+    aged = _ticket("aged-low", "low", enq=-1000.0)
+    assert policy.plan([aged], list(running), now=1000.0).preempt == []
+    # a higher CLASS preempts — but only after min_runtime_seconds
+    high = _ticket("boss", "high")
+    early = policy.plan([high], list(running), now=1.0)
+    assert early.preempt == [] and early.start == []
+    running[0].preempt_requested = False
+    late = policy.plan([high], list(running), now=5.0)
+    assert [t.job_id for t in late.preempt] == ["victim"]
+    # the slot frees at the victim's safe seam: nothing starts this tick
+    assert late.start == []
+    # an already-preempted victim is not preempted twice
+    again = policy.plan([high], list(running), now=6.0)
+    assert again.preempt == []
+
+
+def test_preemption_picks_lowest_class_longest_remainder():
+    policy = SchedulerPolicy(slots=2, aging_rate=1.0,
+                             min_runtime_seconds=0.0)
+    running = [
+        _ticket("low-short", "low", predicted=5.0, started_ts=0.0),
+        _ticket("low-long", "low", predicted=50.0, started_ts=0.0),
+    ]
+    plan = policy.plan([_ticket("boss", "high")], running, now=1.0)
+    # the job holding its slot longest gives the most backlog relief
+    assert [t.job_id for t in plan.preempt] == ["low-long"]
+    # equals never preempt each other even with slots full
+    peers = [_ticket("r1", started_ts=0.0), _ticket("r2", started_ts=0.0)]
+    assert policy.plan([_ticket("q3")], peers, now=10.0).preempt == []
+
+
+def test_shed_decision_prices_the_rejection():
+    live = [_ticket("a", predicted=60.0), _ticket("b", predicted=50.0)]
+    # horizon 0 disables shedding entirely
+    assert SchedulerPolicy(slots=1).shed_decision(live, 1e9) is None
+    # a negative candidate price clamps to 0: live 110s under a 120s
+    # horizon still admits
+    assert SchedulerPolicy(slots=1, shed_horizon_seconds=120.0) \
+        .shed_decision(live, candidate_seconds=-10.0) is None
+    policy = SchedulerPolicy(slots=1, shed_horizon_seconds=100.0)
+    decision = policy.shed_decision(live, candidate_seconds=30.0)
+    assert decision["backlog_seconds"] == pytest.approx(140.0)
+    # retry_after = drain time back to the horizon at full throughput
+    assert decision["retry_after_seconds"] == pytest.approx(40.0)
+    # more slots drain the same backlog faster: no shed
+    assert SchedulerPolicy(slots=2, shed_horizon_seconds=100.0) \
+        .shed_decision(live, 30.0) is None
+
+
+def test_ticket_remaining_tracks_progress():
+    ticket = _ticket("t", predicted=40.0)
+    assert ticket.remaining_seconds() == 40.0
+    ticket.completed_fraction = 0.75
+    assert ticket.remaining_seconds() == pytest.approx(10.0)
+    ticket.completed_fraction = 7.0  # clamped
+    assert ticket.remaining_seconds() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# pricing: the cost model feeds the packer
+# ---------------------------------------------------------------------------
+
+def _ledger_with(tmp_path, records):
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    store = LedgerStore(str(tmp_path / "ledger"))
+    for record in records:
+        store.append(record)
+    return str(tmp_path / "ledger")
+
+
+def _run_record(fingerprint, device_time, wall, rid):
+    return {"ledger_schema": 1, "source": "test", "executor": "sync",
+            "fingerprint": fingerprint, "rounds": 2, "ok_rounds": 2,
+            "round_device_time": device_time, "wall_seconds": wall,
+            "record_id": rid, "time_attribution": {}, "counts": {},
+            "final": {}, "ts": 1.0}
+
+
+def test_pricer_cold_ledger_uses_explicit_default(tmp_path):
+    pricer = JobPricer(str(tmp_path / "nowhere"), default_seconds=42.0)
+    price = pricer.price({"config": job_config(), "name": "j"})
+    assert price["method"] == "default"
+    assert price["predicted_seconds"] == 42.0
+    assert price["rounds"] == 2
+    # a malformed spec never raises — the packer always gets a number
+    bad = pricer.price({"config": "not-a-mapping"})
+    assert bad["method"] == "default" and "error" in bad
+
+
+def test_pricer_corpus_median_beats_configured_default(tmp_path):
+    ledger_dir = _ledger_with(tmp_path, [
+        _run_record("other-fp", 3.0, 7.0, "r1"),
+        _run_record("other-fp", 3.0, 11.0, "r2"),
+        _run_record("other-fp", 3.0, 9.0, "r3"),
+    ])
+    price = JobPricer(ledger_dir, default_seconds=500.0).price(
+        {"config": job_config()})
+    # no fingerprint peer, but the corpus HAS measured history: the
+    # median wall time keeps the backlog estimate in the right decade
+    assert price["method"] == "corpus_median"
+    assert price["predicted_seconds"] == pytest.approx(9.0)
+
+
+def test_pricer_peer_median_per_fingerprint(tmp_path):
+    from attackfl_tpu.config import config_from_dict
+    from attackfl_tpu.utils.fingerprint import config_fingerprint
+
+    fp = config_fingerprint(config_from_dict(job_config()))
+    ledger_dir = _ledger_with(tmp_path, [
+        _run_record(fp, 2.0, 4.5, "p1"),
+        _run_record(fp, 4.0, 8.5, "p2"),
+        _run_record(fp, 3.0, 6.5, "p3"),
+        _run_record("other-fp", 99.0, 200.0, "x1"),
+    ])
+    price = JobPricer(ledger_dir).price({"config": job_config()})
+    assert price["method"] == "peer"
+    assert price["fingerprint"] == fp
+    # median peer device time (3.0) x 2 rounds
+    assert price["predicted_seconds"] == pytest.approx(6.0)
+
+
+def test_estimate_skew_fault_multiplies_prices(tmp_path):
+    from attackfl_tpu.faults.inject import HostFaultInjector
+
+    tel = Telemetry(EventLog(str(tmp_path / "events.jsonl")),
+                    NullTracer(), Counters(), True)
+    injector = HostFaultInjector(
+        parse_fault_plan("estimate_skew@2:count=4"), tel)
+    pricer = JobPricer(str(tmp_path / "nowhere"), default_seconds=10.0,
+                       injector=injector)
+    first = pricer.price({"config": job_config()})
+    assert first["predicted_seconds"] == 10.0 and "skewed_by" not in first
+    skewed = pricer.price({"config": job_config()})
+    # persistent from its trigger onward: a chronically wrong cost model
+    assert skewed["predicted_seconds"] == pytest.approx(40.0)
+    assert skewed["skewed_by"] == 4.0
+    assert pricer.price({"config": job_config()})["skewed_by"] == 4.0
+    events = [json.loads(line) for line in open(tmp_path / "events.jsonl")]
+    assert [e["fault"] for e in events if e["kind"] == "fault"] \
+        == ["estimate_skew"]
+
+
+def test_corpus_default_seconds_unit():
+    from attackfl_tpu.costmodel.estimate import corpus_default_seconds
+
+    assert corpus_default_seconds([]) is None
+    assert corpus_default_seconds([{"wall_seconds": -1.0}]) is None
+    assert corpus_default_seconds(
+        [{"wall_seconds": 2.0}, {"wall_seconds": 8.0},
+         {"wall_seconds": 4.0}, {"wall_seconds": "junk"}]) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# core: durable queue <-> tickets, breaker, shed, starvation freedom
+# ---------------------------------------------------------------------------
+
+class _StubWorker:
+    def __init__(self):
+        self.preempted = False
+
+    def request_preempt(self):
+        self.preempted = True
+
+
+class _Bench:
+    """JobScheduler on a real durable queue with a FAKE clock and stub
+    spawn/workers — deterministic tick-by-tick simulation."""
+
+    def __init__(self, tmp_path, **kw):
+        self.tel = Telemetry(
+            EventLog(str(tmp_path / "service.events.jsonl")),
+            NullTracer(), Counters(), True)
+        self.queue = JobQueue(str(tmp_path / "queue"), depth=64,
+                              telemetry=self.tel)
+        self.now = 0.0
+        self.workers: dict[str, _StubWorker] = {}
+        self.spawned: list[tuple[float, str, dict]] = []
+        kw.setdefault("slots", 1)
+        kw.setdefault("default_cost_seconds", 30.0)
+        self.sched = JobScheduler(
+            self.queue, self.tel, str(tmp_path / "ledger"),
+            spawn=self._spawn, workers=lambda: dict(self.workers),
+            clock=lambda: self.now, **kw)
+
+    def _spawn(self, job, meta):
+        self.workers[job.job_id] = _StubWorker()
+        self.spawned.append((self.now, job.job_id, meta))
+
+    def finish(self, job_id):
+        self.workers.pop(job_id, None)
+        self.queue.mark(job_id, "done", result={})
+
+    def schedule_events(self):
+        events = [json.loads(line)
+                  for line in open(
+                      pathlib.Path(self.queue.directory).parent
+                      / "service.events.jsonl")]
+        return [e for e in events if e["kind"] == "schedule"]
+
+
+def test_core_packs_fifo_when_everything_is_equal(tmp_path):
+    bench = _Bench(tmp_path)
+    jobs = [bench.queue.submit({"name": f"j{i}"}) for i in range(3)]
+    started = []
+    for _ in range(3):
+        bench.sched.tick()
+        running = [j for j in bench.workers]
+        assert len(running) == 1
+        started.append(running[0])
+        bench.now += 1.0
+        bench.finish(running[0])
+    # all-default priorities + equal prices: the old oldest-first
+    # service semantics fall out of the policy unchanged
+    assert started == jobs
+    actions = [e["action"] for e in bench.schedule_events()]
+    assert actions.count("pack") == 3 and "preempt" not in actions
+
+
+def test_core_circuit_breaker_quarantines_crash_loops(tmp_path):
+    bench = _Bench(tmp_path, breaker_attempts=3)
+    looper = bench.queue.submit({"name": "looper"})
+    healthy = bench.queue.submit({"name": "healthy"})
+    bench.queue.mark(looper, "queued", attempts=3, resume=True,
+                     error="IndexError: boom")
+    bench.sched.tick()
+    status = bench.queue.get(looper).status
+    assert status["state"] == "failed"
+    assert status["circuit_broken"] is True
+    assert "circuit breaker open after 3 crash" in status["error"]
+    assert "boom" in status["error"]
+    # the service survives and keeps dispatching the healthy job
+    assert [j for j in bench.workers] == [healthy]
+    assert bench.tel.counters.get("jobs_circuit_broken") == 1
+    breaks = [e for e in bench.schedule_events() if e["action"] == "break"]
+    assert len(breaks) == 1 and breaks[0]["job_id"] == looper
+
+
+def test_core_admit_check_sheds_with_priced_retry_after(tmp_path):
+    bench = _Bench(tmp_path, shed_horizon_seconds=100.0,
+                   default_cost_seconds=60.0)
+    with pytest.raises(ValueError, match="unknown priority"):
+        bench.sched.admit_check({"priority": "urgent"})
+    first = bench.sched.admit_check({"name": "a"})
+    assert first["priority"] == "normal" and first["method"] == "default"
+    bench.queue.submit({"name": "a"})
+    bench.sched.tick()  # materialize the ticket: 60s now live
+    with pytest.raises(OverloadShedError) as err:
+        bench.sched.admit_check({"name": "b"})
+    assert err.value.retry_after_seconds == pytest.approx(20.0)
+    assert bench.tel.counters.get("jobs_shed") == 1
+    shed = [e for e in bench.schedule_events() if e["action"] == "shed"]
+    assert shed and shed[0]["retry_after_seconds"] == pytest.approx(20.0)
+
+
+def test_core_preempt_cycle_with_fake_clock(tmp_path):
+    bench = _Bench(tmp_path, min_runtime_seconds=2.0)
+    low = bench.queue.submit({"name": "low", "priority": "low"})
+    bench.sched.tick()
+    assert bench.workers[low].preempted is False
+    bench.now = 5.0
+    high = bench.queue.submit({"name": "high", "priority": "high"})
+    bench.sched.tick()
+    # the policy named the victim; the slot is NOT free yet — the
+    # worker must reach its round/chunk seam first
+    assert bench.workers[low].preempted is True
+    assert [j for _, j, _ in bench.spawned] == [low]
+    # the worker requeues at the seam, persisting the preemption count
+    bench.workers.pop(low)
+    bench.queue.mark(low, "queued", resume=True, preemptions=1,
+                     priority="low", wait_seconds=0.0)
+    bench.now = 6.0
+    bench.sched.tick()
+    assert [j for _, j, _ in bench.spawned] == [low, high]
+    bench.now = 9.0
+    bench.finish(high)
+    bench.sched.tick()  # low resumes, preemption count rebuilt from status
+    assert [j for _, j, _ in bench.spawned] == [low, high, low]
+    resume_meta = bench.spawned[-1][2]
+    assert resume_meta["preemptions"] == 1
+    assert resume_meta["priority"] == "low"
+    actions = [e["action"] for e in bench.schedule_events()]
+    assert actions.count("preempt") == 1 and actions.count("resume") == 1
+    snap = bench.sched.snapshot()
+    assert snap["preempted_total"] == 1
+    rows = {r["job_id"]: r for r in snap["jobs"]}
+    assert rows[low]["preemptions"] == 1 and rows[low]["state"] == "running"
+
+
+def test_core_starvation_freedom_under_sustained_high_load(tmp_path):
+    """The asserted aging bound: with high-priority jobs arriving
+    faster than they finish, a low-priority job still starts within
+    ``starvation_bound_seconds`` + one job's service time."""
+    bench = _Bench(tmp_path, aging_rate=10.0, min_runtime_seconds=1e9)
+    bound = bench.sched.policy.starvation_bound_seconds()
+    assert bound == pytest.approx(10.0)
+    low = bench.queue.submit({"name": "starved", "priority": "low"})
+    bench.queue.submit({"name": "high-0", "priority": "high"})
+    service_time = 2.0
+    low_started = None
+    for step in range(1, 40):
+        bench.sched.tick()
+        for ts, job_id, _ in bench.spawned:
+            if job_id == low:
+                low_started = ts
+        if low_started is not None:
+            break
+        bench.now = step * service_time
+        # sustained overload: every finished high job is instantly
+        # replaced by a fresh one — without aging, low waits forever
+        for running in list(bench.workers):
+            bench.finish(running)
+        bench.queue.submit({"name": f"high-{step}", "priority": "high"})
+    assert low_started is not None, "low-priority job starved"
+    assert low_started <= bound + service_time
+    # the fresh high submitted the same tick was still waiting: low
+    # genuinely outranked it rather than draining an empty queue
+    queued_highs = [j for j in bench.queue.jobs() if j.state == "queued"]
+    assert queued_highs
+    meta = next(m for _, j, m in bench.spawned if j == low)
+    assert meta["wait_seconds"] == pytest.approx(low_started)
+
+
+def test_preempt_storm_fault_forces_preemption(tmp_path, monkeypatch):
+    from attackfl_tpu.faults.inject import HostFaultInjector
+
+    tel = Telemetry(EventLog(str(tmp_path / "service.events.jsonl")),
+                    NullTracer(), Counters(), True)
+    injector = HostFaultInjector(
+        parse_fault_plan("preempt_storm@2:count=2"), tel)
+    queue = JobQueue(str(tmp_path / "queue"), depth=8, telemetry=tel)
+    workers: dict[str, _StubWorker] = {}
+
+    def spawn(job, meta):
+        workers[job.job_id] = _StubWorker()
+
+    clock = {"t": 0.0}
+    sched = JobScheduler(queue, tel, str(tmp_path / "ledger"), slots=2,
+                         injector=injector, spawn=spawn,
+                         workers=lambda: dict(workers),
+                         clock=lambda: clock["t"])
+    jobs = [queue.submit({"name": f"j{i}"}) for i in range(2)]
+    sched.tick()  # tick 1: both packed, storm not due yet
+    assert all(not workers[j].preempted for j in jobs)
+    clock["t"] = 1.0
+    sched.tick()  # tick 2: the storm preempts BOTH healthy jobs
+    assert all(workers[j].preempted for j in jobs)
+    events = [json.loads(line)
+              for line in open(tmp_path / "service.events.jsonl")]
+    preempts = [e for e in events if e["kind"] == "schedule"
+                and e["action"] == "preempt"]
+    assert len(preempts) == 2
+    assert {e["reason"] for e in preempts} == {"preempt_storm"}
+    assert [e["fault"] for e in events if e["kind"] == "fault"] \
+        == ["preempt_storm"]
+    sched.tick()  # the storm fired once; nothing new to preempt
+    assert tel.counters.get("jobs_preempted") == 2
+
+
+# ---------------------------------------------------------------------------
+# service integration (stubbed executor: fast, deterministic)
+# ---------------------------------------------------------------------------
+
+def _fake_execute(self, resume):
+    """Round-shaped sleeper honoring the worker's stop hook — the full
+    scheduler cycle without jax."""
+    target = int(self.job.spec.get("rounds", 4))
+    status = self.queue.get(self.job.job_id).status
+    completed = int(status.get("completed") or 0) if resume else 0
+    while completed < target:
+        if self._stop_hook(completed):
+            return {"interrupted": True, "completed": completed,
+                    "target": target, "ok_rounds": completed}
+        time.sleep(float(self.job.spec.get("round_seconds", 0.02)))
+        completed += 1
+        self.queue.mark(self.job.job_id, "running", completed=completed,
+                        target=target)
+    return {"interrupted": False, "completed": completed,
+            "target": target, "ok_rounds": completed}
+
+
+def test_service_preempt_requeue_resume_cycle(tmp_path, monkeypatch):
+    from attackfl_tpu.service.worker import JobWorker
+
+    monkeypatch.setattr(JobWorker, "_execute", _fake_execute)
+    service = make_service(tmp_path, run_monitors=False,
+                           sched_min_runtime=0.0, poll_interval=0.02)
+    service.start()
+    try:
+        low = service.submit({"name": "low", "priority": "low",
+                              "rounds": 200, "round_seconds": 0.02})
+        wait_for(lambda: service.queue.get(low).state == "running",
+                 message="low running")
+        high = service.submit({"name": "high", "priority": "high",
+                               "rounds": 3, "round_seconds": 0.02})
+        wait_for(lambda: service.queue.get(high).state == "done",
+                 message="high done")
+        wait_for(lambda: service.queue.get(low).state == "done",
+                 timeout=60, message="low resumed and done")
+    finally:
+        service.drain(timeout=10)
+        service.close()
+    status = service.queue.get(low).status
+    assert status["preemptions"] >= 1
+    assert status["priority"] == "low"
+    events = [json.loads(line)
+              for line in open(tmp_path / "spool" / "service.events.jsonl")]
+    schedule = [(e["action"], e.get("job_id")) for e in events
+                if e["kind"] == "schedule"]
+    assert ("preempt", low) in schedule
+    assert ("resume", low) in schedule
+    assert ("pack", high) in schedule
+    requeued = [e for e in events if e["kind"] == "job"
+                and e["action"] == "requeued"]
+    assert any(e.get("reason") == "preempt" for e in requeued)
+
+
+def test_http_schedule_endpoint_metrics_and_shed_429(tmp_path, monkeypatch):
+    import urllib.error
+    import urllib.request
+
+    from attackfl_tpu.service.worker import JobWorker
+
+    monkeypatch.setattr(JobWorker, "_execute", _fake_execute)
+    service = make_service(tmp_path, run_monitors=False,
+                           sched_shed_horizon=10.0, sched_default_cost=8.0,
+                           poll_interval=0.02)
+    service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        first = _http(base, "/submit", "POST",
+                      {"name": "a", "priority": "high", "rounds": 400,
+                       "round_seconds": 0.05})["job_id"]
+        wait_for(lambda: service.queue.get(first).state == "running",
+                 message="first job running")
+        # a typo'd priority is a 400 at submit, not a worker crash later
+        with pytest.raises(urllib.error.HTTPError) as bad:
+            _http(base, "/submit", "POST", {"priority": "urgent"})
+        assert bad.value.code == 400
+        # the live ticket (8s) + the candidate (8s) blow the 10s
+        # horizon: 429 with the priced retry-after, not a bare no
+        with pytest.raises(urllib.error.HTTPError) as shed:
+            _http(base, "/submit", "POST", {"name": "b"})
+        assert shed.value.code == 429
+        payload = json.loads(shed.value.read().decode())
+        assert payload["retry_after_seconds"] > 0
+        assert "retry in" in payload["error"]
+
+        snap = _http(base, "/schedule")
+        assert snap["slots"] == 1
+        assert snap["shed_horizon_seconds"] == 10.0
+        assert snap["shed_total"] >= 1
+        rows = {r["job_id"]: r for r in snap["jobs"]}
+        assert rows[first]["state"] == "running"
+        assert rows[first]["priority"] == "high"
+        assert rows[first]["pricing_method"] == "default"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            metrics = resp.read().decode()
+        assert "attackfl_sched_backlog_seconds" in metrics
+        assert "attackfl_sched_shed_total 1" in metrics
+    finally:
+        service.drain(timeout=10)
+        service.close()
+
+
+def test_no_scheduler_flag_restores_legacy_dispatch(tmp_path, monkeypatch):
+    from attackfl_tpu.service.worker import JobWorker
+
+    monkeypatch.setattr(JobWorker, "_execute", _fake_execute)
+    service = make_service(tmp_path, run_monitors=False, scheduler=False,
+                           poll_interval=0.02)
+    service.start()
+    base = f"http://127.0.0.1:{service.port}"
+    try:
+        job = service.submit({"name": "legacy", "rounds": 2})
+        wait_for(lambda: service.queue.get(job).state == "done",
+                 message="legacy job done")
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _http(base, "/schedule")
+        assert err.value.code == 404
+    finally:
+        service.drain(timeout=10)
+        service.close()
+    events = [json.loads(line)
+              for line in open(tmp_path / "spool" / "service.events.jsonl")]
+    assert not [e for e in events if e["kind"] == "schedule"]
+
+
+def test_daemon_preempt_storm_requeues_and_resumes(tmp_path, monkeypatch):
+    """The --inject-faults wiring end to end: a storm preempts a
+    healthy running job through the real dispatch loop; the worker
+    requeues at its seam and the scheduler resumes it to completion."""
+    from attackfl_tpu.service.worker import JobWorker
+
+    monkeypatch.setattr(JobWorker, "_execute", _fake_execute)
+    service = make_service(tmp_path, run_monitors=False,
+                           poll_interval=0.02, sched_min_runtime=0.0,
+                           fault_plan=parse_fault_plan(
+                               "preempt_storm@25:count=1"))
+    job = service.submit({"name": "victim", "rounds": 120,
+                          "round_seconds": 0.02})
+    service.start()  # tick 25 lands ~0.5s in, mid-run
+    try:
+        wait_for(lambda: service.queue.get(job).state == "done",
+                 message="storm victim resumed and done")
+    finally:
+        service.drain(timeout=10)
+        service.close()
+    assert service.queue.get(job).status["preemptions"] == 1
+    events = [json.loads(line)
+              for line in open(tmp_path / "spool" / "service.events.jsonl")]
+    preempts = [e for e in events if e["kind"] == "schedule"
+                and e["action"] == "preempt"]
+    assert len(preempts) == 1 and preempts[0]["reason"] == "preempt_storm"
+    assert [e["fault"] for e in events if e["kind"] == "fault"] \
+        == ["preempt_storm"]
+    resumes = [e for e in events if e["kind"] == "schedule"
+               and e["action"] == "resume"]
+    assert resumes and resumes[0]["job_id"] == job
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real executors, real daemon, byte-identical contracts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("executor", ["sync", "pipelined", "fused"])
+def test_stop_reason_rides_run_end_across_executors(tmp_path, executor,
+                                                    monkeypatch):
+    """The preemption seam in every executor: a stop hook returning the
+    REASON string halts at the round boundary, the reason rides the
+    ``run_end`` event, and the checkpoint is a valid resume point
+    (finishing from it is bit-identical to an uninterrupted run)."""
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path))
+    from attackfl_tpu.config import TelemetryConfig, config_from_dict
+    from attackfl_tpu.telemetry.events import validate_event
+    from attackfl_tpu.training.engine import Simulator
+
+    raw = job_config(**{"num-round": 4})
+    cfg = config_from_dict(raw).replace(
+        log_path=str(tmp_path), checkpoint_dir=str(tmp_path),
+        telemetry=TelemetryConfig(monitor=False))
+    sim = Simulator(cfg)
+
+    def stop(done):
+        return "preempt" if done >= 2 else False
+
+    try:
+        if executor == "sync":
+            state, _ = sim.run(verbose=False, stop=stop)
+        elif executor == "pipelined":
+            state, _ = sim.run(verbose=False, pipeline=True, stop=stop)
+        else:
+            state, _ = sim.run_fast(verbose=False, chunk_size=1, stop=stop)
+    finally:
+        sim.close()
+    assert int(state["completed_rounds"]) < 4
+    events = [json.loads(line) for line in open(tmp_path / "events.jsonl")]
+    run_end = [e for e in events if e["kind"] == "run_end"][-1]
+    assert run_end["stop_reason"] == "preempt"
+    assert validate_event(run_end) == []
+    sim_b = Simulator(cfg.replace(
+        resume=True, telemetry=TelemetryConfig(enabled=False)))
+    try:
+        sim_b.run(verbose=False)
+    finally:
+        sim_b.close()
+    assert (tmp_path / "CNNModel.msgpack").read_bytes() \
+        == reference_run(tmp_path, raw)
+
+
+@pytest.mark.slow
+def test_matrix_preempt_at_chunk_boundary_resumes_bit_identical(
+        tmp_path, monkeypatch):
+    """Mid-sweep preemption: stop at a chunk boundary with reason
+    "preempt", observe it on the matrix ``interrupted`` event, resume,
+    and every cell's final params match an uninterrupted sweep."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from attackfl_tpu.config import AttackSpec, TelemetryConfig, audit_config
+    from attackfl_tpu.matrix.grid import GridSpec
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    monkeypatch.setenv("ATTACKFL_TELEMETRY_DIR", str(tmp_path / "tel"))
+    (tmp_path / "tel").mkdir()
+    grid = GridSpec(
+        attacks=(AttackSpec(mode="LIE", num_clients=1, attack_round=2),),
+        defenses=("fedavg",), seeds=(1, 2), rounds=3, chunk=1)
+
+    def leaves_equal(a, b):
+        la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+        return len(la) == len(lb) and all(
+            np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+            for x, y in zip(la, lb))
+
+    ref = MatrixRun(audit_config(
+        prng_impl="threefry2x32", telemetry=TelemetryConfig(enabled=False),
+        log_path=str(tmp_path / "ref"),
+        checkpoint_dir=str(tmp_path / "ref")), grid)
+    ref_final, _ = ref.run(verbose=False)
+    ref.close()
+
+    work = tmp_path / "work"
+    first = MatrixRun(audit_config(
+        prng_impl="threefry2x32", telemetry=TelemetryConfig(monitor=False),
+        log_path=str(work), checkpoint_dir=str(work)), grid)
+    first.run(verbose=False,
+              stop=lambda done: "preempt" if done >= 2 else False)
+    assert first.interrupted and first.stop_reason == "preempt"
+    first.close()
+    events = [json.loads(line)
+              for line in open(tmp_path / "tel" / "events.jsonl")]
+    interrupted = [e for e in events if e["kind"] == "matrix"
+                   and e["action"] == "interrupted"]
+    assert interrupted and interrupted[-1]["stop_reason"] == "preempt"
+
+    resumed = MatrixRun(audit_config(
+        prng_impl="threefry2x32", telemetry=TelemetryConfig(enabled=False),
+        log_path=str(work), checkpoint_dir=str(work), resume=True), grid)
+    res_final, _ = resumed.run(verbose=False)
+    assert not resumed.interrupted
+    resumed.close()
+    for key, params in ref_final.items():
+        assert leaves_equal(params, res_final[key]), \
+            f"cell {key} not byte-identical after preempt+resume"
+
+
+@pytest.mark.slow
+def test_service_preempts_real_run_and_resumes_bit_identical(tmp_path):
+    """The tentpole cycle with REAL jobs: a high-priority submission
+    preempts a running low-priority run at its round boundary; the low
+    job requeues with its preemption persisted, resumes after the high
+    job, and finishes byte-identical to an uninterrupted reference.
+    The provenance rides the run header into the ledger."""
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    from tests.test_service import job_checkpoint_bytes
+
+    raw_low = job_config(**{"num-round": 4})
+    raw_high = job_config(**{"num-round": 2, "random-seed": 2})
+    service = make_service(tmp_path, run_monitors=False,
+                           sched_min_runtime=0.0, poll_interval=0.05)
+    service.start()
+    try:
+        low = service.submit({"config": raw_low, "name": "low",
+                              "priority": "low"})
+        wait_for(lambda: service.queue.get(low).state == "running",
+                 message="low running")
+        high = service.submit({"config": raw_high, "name": "high",
+                               "priority": "high"})
+        wait_for(lambda: int(service.queue.get(low).status
+                             .get("preemptions") or 0) >= 1,
+                 timeout=180, message="low preempted")
+        for job in (low, high):
+            wait_for(lambda j=job: service.queue.get(j).state == "done",
+                     timeout=300, message=f"job {job} done")
+    finally:
+        service.drain(timeout=30)
+        service.close()
+    assert job_checkpoint_bytes(service, low) \
+        == reference_run(tmp_path, raw_low)
+    status = service.queue.get(low).status
+    assert status["preemptions"] >= 1 and status["priority"] == "low"
+    job_events = [json.loads(line) for line in open(
+        pathlib.Path(service.spool) / "jobs" / low / "events.jsonl")]
+    headers = [e for e in job_events if e["kind"] == "run_header"]
+    assert headers[0]["sched_priority"] == "low"
+    assert any(h.get("sched_preemptions", 0) >= 1 for h in headers)
+    assert any(e.get("stop_reason") == "preempt" for e in job_events
+               if e["kind"] == "run_end")
+    records, _ = LedgerStore(service.ledger_dir).load()
+    mined = [r for r in records if r.get("sched_preemptions")]
+    assert mined and mined[-1]["sched_priority"] == "low"
+    assert mined[-1]["sched_wait_seconds"] >= 0
+
+
+@pytest.mark.slow
+def test_chaos_kill_nine_mid_preemption_mixed_workload(tmp_path):
+    """THE ISSUE-15 chaos gate: a real daemon running a mixed run +
+    matrix workload is SIGKILLed mid-preemption (the preempt decision
+    is evented but the victim may not have reached its seam); the
+    restarted daemon replays the queue, re-dispatches through the
+    scheduler, and every final artifact is byte-identical to an
+    uninterrupted reference."""
+    from attackfl_tpu.config import TelemetryConfig, config_from_dict
+    from attackfl_tpu.matrix.grid import grid_from_dict
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    spool = tmp_path / "spool"
+    raw_low = job_config(**{"num-round": 3})
+    grid = {"attacks": ["LIE"], "attack-clients": 1, "attack-round": 2,
+            "defenses": ["fedavg"], "seeds": [1], "rounds": 2, "chunk": 1}
+    proc = subprocess.Popen(_daemon_cmd(spool), env=_daemon_env(),
+                            cwd=str(REPO), stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        base = _wait_daemon(proc, spool)
+        low = _http(base, "/submit", "POST",
+                    {"config": raw_low, "name": "low",
+                     "priority": "low"})["job_id"]
+        manifest = spool / "jobs" / low / "manifest.json"
+        wait_for(manifest.exists, timeout=180, message="low checkpoint")
+        mat = _http(base, "/submit", "POST",
+                    {"type": "matrix", "name": "sweep", "priority": "high",
+                     "config": job_config(), "grid": grid,
+                     "sweep_id": "chaos-sweep"})["job_id"]
+
+        def preempt_evented():
+            try:
+                lines = open(spool / "service.events.jsonl").readlines()
+            except OSError:
+                return False
+            for line in lines:
+                event = json.loads(line)
+                if event.get("kind") == "schedule" \
+                        and event.get("action") == "preempt":
+                    return True
+            return False
+
+        wait_for(preempt_evented, timeout=180, message="preempt decision")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        proc = subprocess.Popen(_daemon_cmd(spool), env=_daemon_env(),
+                                cwd=str(REPO), stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        base = _wait_daemon(proc, spool)
+
+        def all_done():
+            states = {j["job_id"]: j["state"]
+                      for j in _http(base, "/jobs")["jobs"]}
+            bad = [j for j in (low, mat)
+                   if states.get(j) in ("failed", "cancelled")]
+            assert not bad, f"job(s) {bad} terminal-failed: {states}"
+            return all(states.get(j) == "done" for j in (low, mat))
+
+        wait_for(all_done, timeout=420, interval=0.3,
+                 message="mixed workload done after restart")
+        os.kill(proc.pid, signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # the run job: byte-identical to an uninterrupted reference
+    assert (spool / "jobs" / low / "CNNModel.msgpack").read_bytes() \
+        == reference_run(tmp_path, raw_low)
+    # the matrix job: its newest sweep checkpoint entry is byte-identical
+    # to an uninterrupted in-process sweep of the same grid + config
+    ref_dir = tmp_path / "matrix-ref"
+    cfg = config_from_dict(job_config()).replace(
+        log_path=str(ref_dir), checkpoint_dir=str(ref_dir),
+        prng_impl="threefry2x32", telemetry=TelemetryConfig(enabled=False))
+    runner = MatrixRun(cfg, grid_from_dict(grid), sweep_id="chaos-sweep")
+    runner.run(verbose=False)
+    runner.close()
+    ref_entries = sorted(ref_dir.glob("matrix.r*.msgpack"))
+    job_entries = sorted((spool / "jobs" / mat).glob("matrix.r*.msgpack"))
+    assert ref_entries and job_entries
+    assert job_entries[-1].read_bytes() == ref_entries[-1].read_bytes()
+    # the mid-preemption evidence survived the kill
+    events = [json.loads(line)
+              for line in open(spool / "service.events.jsonl")]
+    schedule_actions = [e["action"] for e in events
+                        if e["kind"] == "schedule"]
+    assert "preempt" in schedule_actions
+    assert schedule_actions.count("admit") >= 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: the one-shot scheduler smoke gate, wired into tier-1
+# ---------------------------------------------------------------------------
+
+def test_sched_smoke_script():
+    """scripts/sched_smoke.sh — the tier-1 preempt -> resume -> ledger
+    lifecycle against a real daemon (the scheduler sibling of
+    scripts/service_smoke.sh)."""
+    result = subprocess.run(
+        ["bash", str(REPO / "scripts" / "sched_smoke.sh")],
+        cwd=str(REPO), env=_daemon_env(), capture_output=True, text=True,
+        timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "sched smoke: OK" in result.stdout
+
+
+def test_tick_change_detection_skips_redundant_rescans(tmp_path):
+    """A saturated slot must not pay a full sealed-entry queue rescan
+    per poll interval: with no durable mutation, no worker-set change
+    and no storm pending, tick() early-returns inside the rescan
+    window; any queue publish (round progress, submit, requeue) or a
+    pending preempt_storm forces the scan immediately."""
+    bench = _Bench(tmp_path)
+    bench.sched.rescan_seconds = 3600.0  # isolate the version/worker gate
+    job = bench.queue.submit({"name": "busy"})
+    bench.sched.tick()
+    assert job in bench.workers  # packed: the slot is now saturated
+    bench.sched.tick()  # catch-up scan (the start's own claim publish)
+
+    scans = []
+    real_jobs = bench.queue.jobs
+
+    def counting_jobs():
+        scans.append(1)
+        return real_jobs()
+
+    bench.queue.jobs = counting_jobs
+    for _ in range(50):
+        bench.sched.tick()
+    assert not scans, "idle ticks must not rescan the durable queue"
+
+    # a durable publish (the worker's round-progress mark) is change
+    bench.queue.mark(job, "running", completed=1, target=4)
+    bench.sched.tick()
+    assert len(scans) == 1
+    bench.sched.tick()
+    assert len(scans) == 1  # and the next idle tick skips again
+
+    # the time-based fallback still bounds staleness (aging/anti-thrash)
+    bench.sched.rescan_seconds = 0.0
+    bench.sched.tick()
+    assert len(scans) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: bench --contention -> ledger mapping
+# ---------------------------------------------------------------------------
+
+def test_records_from_bench_contention_mapping():
+    """--contention -> one record per dispatch mode, each with its own
+    baseline trajectory, carrying the contention economics the ROADMAP
+    item asks for (makespan, wait, throughput, preemptions)."""
+    from attackfl_tpu.ledger.record import records_from_bench, validate_record
+
+    line = {"metric": "fl_contention_sched_vs_serial", "value": 0.37,
+            "unit": "jobs/s", "kind": "metric", "ts": 1.0,
+            "detail": {"config": "contention: 6-job mixed workload",
+                       "jobs": 6, "reps": 3,
+                       "throughput_ratio": 1.01,
+                       "serialized": {"makespan_s_mean": 16.0,
+                                      "mean_wait_s": 6.8,
+                                      "throughput_jobs_per_s": 0.375,
+                                      "preemptions": 0, "jobs": 6,
+                                      "per_rep": [16.2, 15.8]},
+                       "scheduler": {"makespan_s_mean": 15.8,
+                                     "mean_wait_s": 6.2,
+                                     "throughput_jobs_per_s": 0.38,
+                                     "preemptions": 0, "jobs": 6,
+                                     "per_rep": [15.9, 15.7]}}}
+    records = records_from_bench(line)
+    assert [r["bench_variant"] for r in records] == ["serialized",
+                                                     "scheduler"]
+    assert all(validate_record(r) == [] for r in records)
+    assert records[0]["fingerprint"] != records[1]["fingerprint"]
+    sched = records[1]
+    assert sched["wall_seconds"] == 15.8
+    assert sched["mean_wait_s"] == 6.2
+    assert sched["throughput_jobs_per_s"] == 0.38
+    assert sched["per_rep"] == [15.9, 15.7]
+    assert sched["throughput_ratio"] == 1.01
+
+
+def test_import_committed_contention_artifact(tmp_path):
+    """The committed BENCH_SCHED.json ingests cleanly and holds the
+    acceptance contract: contention throughput under the scheduler at
+    least matches serialized dispatch (paired means), and the packer's
+    prices stayed inside the 2x cost-validate contract."""
+    from attackfl_tpu.ledger.cli import main as ledger_main
+    from attackfl_tpu.ledger.store import LedgerStore
+
+    artifact = REPO / "BENCH_SCHED.json"
+    rc = ledger_main(["import", str(artifact), "--dir", str(tmp_path)])
+    assert rc == 0
+    records, _ = LedgerStore(str(tmp_path)).load()
+    assert {r["bench_variant"] for r in records} == {"serialized",
+                                                     "scheduler"}
+    parsed = json.loads(artifact.read_text())
+    detail = parsed["detail"]
+    assert detail["throughput_ratio"] >= 1.0 - 0.05  # paired means, CPU noise
+    contract = detail["cost_contract"]
+    assert contract["within_2x"] is True
+    assert contract["leave_one_out"]["median_error_factor"] <= 2.0

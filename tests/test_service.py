@@ -243,10 +243,29 @@ def test_queue_torn_fault_tears_a_status_publish(tmp_path, monkeypatch):
 # worker supervision: crash -> backoff restarts -> resume; budget -> failed
 # ---------------------------------------------------------------------------
 
-def test_backoff_delay_is_bounded_exponential():
-    delays = [backoff_delay(n, 0.5, 30.0) for n in range(1, 9)]
-    assert delays[:4] == [0.5, 1.0, 2.0, 4.0]
-    assert delays[-1] == 30.0  # capped
+def test_backoff_delay_is_decorrelated_jitter():
+    import random as _random
+
+    # chained delays stay inside [base, min(3*prev, cap)] — jittered so
+    # a crashing worker herd does NOT retry in lockstep, capped so the
+    # worst case stays bounded
+    rng = _random.Random(7)
+    base, cap = 0.5, 30.0
+    prev = None
+    for attempt in range(1, 12):
+        delay = backoff_delay(attempt, base, cap, prev=prev, rng=rng)
+        high = min(max(3.0 * (prev if prev is not None else base), base), cap)
+        assert base <= delay <= max(high, base)
+        assert delay <= cap
+        prev = delay
+    # same seed -> same schedule (the determinism seam tests rely on)
+    mk = lambda seed: [
+        backoff_delay(n, base, cap, prev=None if n == 1 else 1.0,
+                      rng=_random.Random(seed)) for n in (1, 2)]
+    assert mk(3) == mk(3)
+    # degenerate config: base above cap never inverts the range
+    assert backoff_delay(1, 5.0, 1.0, prev=None,
+                         rng=_random.Random(0)) == 1.0
 
 
 def test_build_job_config_enforces_isolation(tmp_path):
