@@ -323,6 +323,30 @@ def _http_get_json(url: str, timeout: float = 5.0):
         return resp.status, json.loads(resp.read().decode() or "{}")
 
 
+def _http_get_text(url: str, timeout: float = 5.0) -> tuple[int, str]:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _parse_prom(text: str) -> dict:
+    """Minimal Prometheus text-exposition parser: ``{name{labels} ->
+    float}`` with the raw label string kept as part of the key (enough
+    to read back the gauges our own ``metrics_text`` writes)."""
+    gauges: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            gauges[name] = float(value)
+        except ValueError:
+            continue
+    return gauges
+
+
 def _watch_backoff(failures: int, interval: float, cap: float = 60.0) -> float:
     """Capped exponential backoff for unreachable monitors: the normal
     poll period for the first miss, doubling per consecutive miss, never
@@ -383,6 +407,66 @@ def _watch_schedule(base: str, args) -> int:
         time.sleep(args.interval)
 
 
+def _watch_fleet(base: str, args) -> int:
+    """``attackfl-tpu watch --fleet``: poll a run service's Prometheus
+    ``/metrics`` endpoint (ISSUE 16) and render the scheduler + SLO
+    gauges one line per poll — queue depth, running jobs, per-priority
+    p95 waits, preemption/shed rates.  Same capped-backoff forgiveness
+    as every other watcher."""
+    import http.client
+    import urllib.error
+
+    failures = 0
+    while True:
+        try:
+            _, text = _http_get_text(base + "/metrics")
+        except urllib.error.HTTPError as e:
+            print(f"[watch] /metrics -> http {e.code}", file=sys.stderr)
+            return 2
+        except (urllib.error.URLError, http.client.HTTPException, OSError,
+                ValueError) as e:
+            failures += 1
+            delay = _watch_backoff(failures, args.interval,
+                                   args.max_backoff)
+            print(f"[watch] {base} unreachable: {e} "
+                  f"(retry {failures} in {delay:.1f}s)", file=sys.stderr)
+            if args.once:
+                return 2
+            time.sleep(delay)
+            continue
+        failures = 0
+        gauges = _parse_prom(text)
+
+        def g(name: str, default: float = 0.0) -> float:
+            return gauges.get(name, default)
+
+        line = (f"[watch] fleet queue={g('attackfl_sched_queue_depth'):.0f} "
+                f"running={g('attackfl_sched_running_jobs'):.0f} "
+                f"backlog={g('attackfl_sched_backlog_seconds'):.1f}s "
+                f"preempted={g('attackfl_sched_preempted_total'):.0f} "
+                f"shed={g('attackfl_sched_shed_total'):.0f}")
+        slo_parts = []
+        for name, value in sorted(gauges.items()):
+            if name.startswith("attackfl_slo_queue_wait_p95_seconds{"):
+                prio = name.split('priority="', 1)[-1].rstrip('"}')
+                slo_parts.append(f"p95[{prio}]={value:.1f}s")
+        if "attackfl_slo_preemption_rate" in gauges:
+            slo_parts.append(
+                f"preempt-rate={gauges['attackfl_slo_preemption_rate']}")
+        if "attackfl_slo_shed_rate" in gauges:
+            slo_parts.append(
+                f"shed-rate={gauges['attackfl_slo_shed_rate']}")
+        margin = gauges.get("attackfl_slo_starvation_bound_margin_seconds")
+        if margin is not None:
+            slo_parts.append(f"starv-margin={margin:.1f}s")
+        if slo_parts:
+            line += "  slo: " + " ".join(slo_parts)
+        print(line, flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
 def watch_main(argv=None) -> int:
     """``attackfl-tpu watch``: thin poller of a live run's monitor
     endpoint (``--monitor`` on run/server) — prints each new round as it
@@ -414,10 +498,18 @@ def watch_main(argv=None) -> int:
                              "instead: queue depth, backlog vs horizon, "
                              "per-job effective priorities and "
                              "preemption/wait accounting")
+    parser.add_argument("--fleet", action="store_true",
+                        help="watch a run SERVICE's Prometheus /metrics "
+                             "endpoint instead: scheduler gauges + the "
+                             "fleet SLO gauges (per-priority p95 queue "
+                             "wait, preemption/shed rates, starvation "
+                             "margin)")
     args = parser.parse_args(argv)
     base = args.url.rstrip("/")
     if args.schedule:
         return _watch_schedule(base, args)
+    if args.fleet:
+        return _watch_fleet(base, args)
 
     seen_round = object()
     stalled = False
@@ -575,6 +667,17 @@ def cost_main(argv=None) -> int:
     return _cost_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def fleet_main(argv=None) -> int:
+    """``attackfl-tpu fleet``: the fleet observatory over a service
+    spool — ``report`` prints the SLO gauges + the per-tenant
+    device-time ledger (books must close: busy + idle = wall x slots),
+    ``trace`` writes the Perfetto-loadable cross-job trace.  Jax-free,
+    like ``metrics`` and ``ledger``."""
+    from attackfl_tpu.telemetry.fleet import main as _fleet_main
+
+    return _fleet_main(list(sys.argv[1:] if argv is None else argv))
+
+
 def ledger_main(argv=None) -> int:
     """``attackfl-tpu ledger``: the persistent cross-run store —
     ``list``/``show`` query it, ``compare`` diffs two runs (or a run
@@ -598,6 +701,7 @@ _SUBCOMMANDS = {
     "matrix": matrix_main,
     "serve": serve_main,
     "job": job_main,
+    "fleet": fleet_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -626,6 +730,10 @@ commands:
            is recovered by queue replay + checkpoint resume
   job      service client (jax-free): submit/list/status/cancel/wait over
            HTTP (reads <spool>/service.json for discovery)
+  fleet    fleet observatory over a service spool: report = per-tenant
+           device-time ledger (busy + idle = wall x slots) + SLO gauges;
+           trace = one Perfetto-loadable cross-job trace (slot occupancy,
+           queue waits, preemption gaps, chunk spans)
 """
 
 

@@ -209,6 +209,19 @@ def corpus_default_seconds(records: list[dict[str, Any]]
     return statistics.median(walls)
 
 
+def prediction_error_factor(predicted: float | None,
+                            actual: float | None) -> float | None:
+    """The symmetric error factor ``max(pred/actual, actual/pred)`` —
+    the same statistic the leave-one-out validation reports — as a
+    None-safe join for the fleet observatory's predicted-vs-actual
+    column.  None (or a non-positive side) means "no joinable pair",
+    never a crash: the ledger row shows the hole instead of hiding it."""
+    p, a = _num(predicted), _num(actual)
+    if p is None or a is None or p <= 0 or a <= 0:
+        return None
+    return round(max(p / a, a / p), 4)
+
+
 def validate_predictions(records: list[dict[str, Any]],
                          window: int = DEFAULT_WINDOW) -> dict[str, Any]:
     """Leave-one-out replay: predict every measured record from the rest

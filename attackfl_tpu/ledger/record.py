@@ -298,6 +298,14 @@ def derive_record(events: list[dict[str, Any]],
     if isinstance(sched_wait, bool) \
             or not isinstance(sched_wait, (int, float)):
         sched_wait = None
+    # fleet-trace provenance (ISSUE 16, schema v12): the causal id, the
+    # device slot and the tenant the dispatching scheduler stamped, so a
+    # ledger record joins the fleet timeline/accounting by id
+    sched_fleet_id = header.get("sched_fleet_id")
+    sched_tenant = header.get("sched_tenant")
+    sched_slot = header.get("sched_slot")
+    if isinstance(sched_slot, bool) or not isinstance(sched_slot, int):
+        sched_slot = None
 
     programs = profiles_from_events(events) or None
     utilization = None
@@ -330,6 +338,11 @@ def derive_record(events: list[dict[str, Any]],
         "sched_preemptions": sched_preemptions,
         "sched_wait_seconds": (round(sched_wait + 0.0, 6)
                                if sched_wait is not None else None),
+        "sched_fleet_id": (str(sched_fleet_id)
+                           if sched_fleet_id is not None else None),
+        "sched_tenant": (str(sched_tenant)
+                         if sched_tenant is not None else None),
+        "sched_slot": sched_slot,
         "resumed": summary.get("resumed_from") is not None,
         "fingerprint": fingerprint,
         "git_rev": str(header.get("git_rev") or ""),

@@ -53,7 +53,11 @@ INDEX_FIELDS = ("record_id", "ts", "run_id", "fingerprint", "executor",
                 "source", "mode", "model", "total_clients", "rounds",
                 "ok_rounds", "rounds_per_sec_steady", "sweep_id", "cell",
                 "pipeline_depth", "pipeline_depth_effective",
-                "mesh_devices")
+                "mesh_devices",
+                # scheduler accounting (ISSUE 15/16): None on runs that
+                # never went through the service scheduler
+                "sched_priority", "sched_preemptions",
+                "sched_wait_seconds", "sched_tenant")
 
 
 def resolve_ledger_dir(explicit: str | None = None,

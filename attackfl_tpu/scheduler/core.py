@@ -59,6 +59,21 @@ def spec_priority(spec: dict[str, Any]) -> str:
     return name
 
 
+def spec_tenant(spec: dict[str, Any], job_id: str) -> str:
+    """The tenant a job's device time bills to (ISSUE 16): an explicit
+    ``tenant`` spec field, else the submitter's job ``name``, else the
+    job id itself — never empty, so the fleet books always have a row."""
+    return str(spec.get("tenant") or spec.get("name") or job_id)
+
+
+def spec_fleet_id(spec: dict[str, Any], job_id: str) -> str:
+    """The job's causal fleet-trace id: stamped into the sealed spec at
+    submit (so it survives daemon restarts and preemption requeues);
+    legacy entries predating the field fall back to the job id, which is
+    just as durable a join key."""
+    return str(spec.get("fleet_id") or job_id)
+
+
 class JobScheduler:
     """One service's scheduler.  Thread-safety mirrors the daemon: the
     dispatcher thread ticks; the HTTP thread calls ``admit_check`` and
@@ -91,6 +106,11 @@ class JobScheduler:
         self._clock = clock
         self._lock = threading.Lock()
         self._tickets: dict[str, Ticket] = {}
+        # slot occupancy (ISSUE 16): job_id -> (slot index, acquire
+        # monotonic ts).  Rebuilt implicitly after a restart — replayed
+        # jobs re-acquire on their resume pack, and the fleet stitcher
+        # clamps any unreleased span at the session boundary.
+        self._slot_book: dict[str, tuple[int, float]] = {}
         self._tick_seq = 0
         self.last_backlog_seconds = 0.0
         # change detection: a saturated slot must not cost a sealed-entry
@@ -107,6 +127,41 @@ class JobScheduler:
 
     def _emit(self, action: str, **fields: Any) -> None:
         self.telemetry.events.emit("schedule", action=action, **fields)
+
+    # ---- slot occupancy (ISSUE 16) ----------------------------------
+
+    def _acquire_slot(self, ticket: Ticket) -> int:
+        """Lowest free device-slot index for a starting job; emits the
+        schema-v12 ``slot`` acquire record the fleet books are built
+        from."""
+        used = {slot for slot, _ in self._slot_book.values()}
+        slot = next(i for i in range(len(used) + 1) if i not in used)
+        self._slot_book[ticket.job_id] = (slot, self._clock())
+        self.telemetry.events.emit(
+            "slot", slot=slot, action="acquire", job_id=ticket.job_id,
+            priority=ticket.priority, tenant=ticket.tenant,
+            fleet_id=ticket.fleet_id)
+        return slot
+
+    def _release_slot(self, job_id: str, reason: str,
+                      ticket: Ticket | None = None) -> None:
+        """Release ``job_id``'s slot (job left the running set for any
+        reason) with the measured busy time.  Idempotent — jobs that
+        never held a slot (legacy dispatch, replay windows) are a
+        no-op."""
+        entry = self._slot_book.pop(job_id, None)
+        if entry is None:
+            return
+        slot, acquired = entry
+        fields: dict[str, Any] = {
+            "slot": slot, "action": "release", "job_id": job_id,
+            "reason": reason,
+            "busy_seconds": round(max(self._clock() - acquired, 0.0), 6),
+        }
+        if ticket is not None:
+            fields.update(priority=ticket.priority, tenant=ticket.tenant,
+                          fleet_id=ticket.fleet_id)
+        self.telemetry.events.emit("slot", **fields)
 
     # ---- admission (HTTP thread) ------------------------------------
 
@@ -148,6 +203,8 @@ class JobScheduler:
         for job in jobs:
             state = job.state
             if state not in ("queued", "running"):
+                self._release_slot(job.job_id, reason=state,
+                                   ticket=self._tickets.get(job.job_id))
                 self._tickets.pop(job.job_id, None)
                 continue
             seen.add(job.job_id)
@@ -163,7 +220,9 @@ class JobScheduler:
                 if ticket.started_ts is not None:
                     # came back from a preempt/drain requeue: refresh the
                     # persisted progress + preemption count and re-enter
-                    # the wait clock
+                    # the wait clock (the slot came free with it)
+                    self._release_slot(job.job_id, reason="preempt",
+                                       ticket=ticket)
                     ticket.started_ts = None
                     ticket.preempt_requested = False
                     ticket.enqueued_ts = now
@@ -182,6 +241,8 @@ class JobScheduler:
                 running.append(ticket)
         for job_id in list(self._tickets):
             if job_id not in seen:
+                self._release_slot(job_id, reason="gone",
+                                   ticket=self._tickets.get(job_id))
                 self._tickets.pop(job_id, None)
         return queued, running
 
@@ -197,11 +258,14 @@ class JobScheduler:
             preemptions=int(status.get("preemptions", 0)),
             wait_seconds=float(status.get("wait_seconds", 0.0) or 0.0),
             seq=int(job.spec.get("seq", 0)),
+            fleet_id=spec_fleet_id(job.spec, job.job_id),
+            tenant=spec_tenant(job.spec, job.job_id),
         )
         self._refresh_progress(ticket, status)
         self._tickets[job.job_id] = ticket
         self._emit("admit", job_id=job.job_id, priority=ticket.priority,
                    predicted_seconds=ticket.predicted_seconds,
+                   fleet_id=ticket.fleet_id, tenant=ticket.tenant,
                    reason=str(price.get("method", "")))
         return ticket
 
@@ -276,6 +340,7 @@ class JobScheduler:
         self._emit("preempt", job_id=ticket.job_id,
                    priority=ticket.priority, reason=reason,
                    preemptions=ticket.preemptions + 1,
+                   fleet_id=ticket.fleet_id, tenant=ticket.tenant,
                    predicted_seconds=round(ticket.remaining_seconds(), 6))
 
     def _start(self, ticket: Ticket, now: float) -> None:
@@ -286,10 +351,14 @@ class JobScheduler:
         ticket.wait_seconds = round(
             ticket.wait_seconds + max(now - ticket.enqueued_ts, 0.0), 6)
         ticket.started_ts = now
+        slot = self._acquire_slot(ticket)
         sched_meta = {
             "priority": ticket.priority,
             "preemptions": ticket.preemptions,
             "wait_seconds": ticket.wait_seconds,
+            "fleet_id": ticket.fleet_id,
+            "tenant": ticket.tenant,
+            "slot": slot,
         }
         # persist the accounting next to the job so it survives daemon
         # restarts and `job status` shows it without the event log
@@ -301,6 +370,8 @@ class JobScheduler:
                    wait_seconds=ticket.wait_seconds,
                    preemptions=ticket.preemptions,
                    backlog_seconds=self.last_backlog_seconds,
+                   fleet_id=ticket.fleet_id, tenant=ticket.tenant,
+                   slot=slot,
                    reason=str(ticket.pricing.get("method", "")))
         if self._spawn is not None:
             self._spawn(job, sched_meta)
@@ -315,10 +386,14 @@ class JobScheduler:
             for ticket in sorted(
                     tickets, key=lambda t: (t.started_ts is None, t.seq)):
                 waiting = ticket.started_ts is None
+                slot_entry = self._slot_book.get(ticket.job_id)
                 rows.append({
                     "job_id": ticket.job_id,
                     "state": "queued" if waiting else "running",
                     "priority": ticket.priority,
+                    "fleet_id": ticket.fleet_id,
+                    "tenant": ticket.tenant,
+                    "slot": slot_entry[0] if slot_entry else None,
                     "effective_priority": round(
                         self.policy.effective_priority(ticket, now), 3)
                     if waiting else ticket.base,
@@ -334,6 +409,26 @@ class JobScheduler:
                 })
             waits = [r["wait_seconds"] for r in rows
                      if r["state"] == "queued"]
+            # per-priority queue-wait evidence for the fleet SLO gauges
+            # (ISSUE 16): count + p95 + max over the QUEUED rows of each
+            # class, so /metrics can export them without replaying events
+            from attackfl_tpu.telemetry.summary import percentile
+
+            waits_by_priority: dict[str, dict[str, Any]] = {}
+            for row in rows:
+                if row["state"] != "queued":
+                    continue
+                bucket = waits_by_priority.setdefault(
+                    row["priority"], {"waits": []})
+                bucket["waits"].append(row["wait_seconds"])
+            waits_by_priority = {
+                prio: {
+                    "count": len(b["waits"]),
+                    "p95_seconds": round(percentile(b["waits"], 95.0), 3),
+                    "max_seconds": round(max(b["waits"]), 3),
+                }
+                for prio, b in waits_by_priority.items()
+            }
             counters = self.telemetry.counters.snapshot()
             return {
                 "slots": self.policy.slots,
@@ -344,7 +439,10 @@ class JobScheduler:
                 "breaker_attempts": self.breaker_attempts,
                 "backlog_seconds": self.last_backlog_seconds,
                 "queue_depth": len(waits),
+                "running_jobs": sum(
+                    1 for r in rows if r["state"] == "running"),
                 "max_wait_seconds": round(max(waits), 3) if waits else 0.0,
+                "waits_by_priority": waits_by_priority,
                 "preempted_total": int(counters.get("jobs_preempted", 0)),
                 "shed_total": int(counters.get("jobs_shed", 0)),
                 "circuit_broken_total": int(

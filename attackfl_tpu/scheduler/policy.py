@@ -84,6 +84,12 @@ class Ticket:
     wait_seconds: float = 0.0  # accumulated across dispatches
     preempt_requested: bool = False
     seq: int = 0
+    # fleet-trace identity (ISSUE 16): the causal id every schedule/slot
+    # event names (stamped at submit, durable in the sealed spec), and
+    # the tenant the job's device time bills to.  Pure pass-through for
+    # the policy — decisions never read either.
+    fleet_id: str = ""
+    tenant: str = ""
 
     @property
     def base(self) -> int:

@@ -46,6 +46,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any
 
 from attackfl_tpu.scheduler.core import JobScheduler, OverloadShedError
@@ -143,10 +144,23 @@ class RunService:
         self.started_ts = round(time.time(), 6)
         replay = self.queue.replay()
         self._http.start()
+        started_fields: dict[str, Any] = {}
+        if self.scheduler is not None:
+            # the fleet stitcher (telemetry.fleet) reads these constants
+            # off the started event so the offline SLO report can place
+            # observed waits against the configured starvation bound
+            started_fields = {
+                "slots": self.scheduler.policy.slots,
+                "aging_rate": self.scheduler.policy.aging_rate,
+                "starvation_bound_seconds": round(
+                    self.scheduler.policy.starvation_bound_seconds(), 6),
+                "shed_horizon_seconds":
+                    self.scheduler.policy.shed_horizon_seconds,
+            }
         self.telemetry.events.emit(
             "service", action="started", port=self._http.port,
             spool=self.spool, max_workers=self.max_workers,
-            queue_depth=self.queue.depth)
+            queue_depth=self.queue.depth, **started_fields)
         if replay["requeued"] or replay["torn"]:
             self.telemetry.events.emit(
                 "service", action="replayed",
@@ -280,6 +294,12 @@ class RunService:
             grid_from_dict(dict(spec.get("grid") or {}))
         if not spec.get("config"):
             spec = dict(spec, config=self.base_config)
+        if not spec.get("fleet_id"):
+            # fleet-trace id (ISSUE 16): stamped BEFORE the queue seals
+            # the spec, so the causal id survives daemon restarts and
+            # preemption requeues — every schedule/slot event and the
+            # run header name this one id from submit to completion
+            spec = dict(spec, fleet_id=uuid.uuid4().hex[:12])
         if self.scheduler is not None:
             # validates the priority class (400 on typos), prices the
             # job, and raises OverloadShedError (429 + retry-after) when
@@ -356,6 +376,8 @@ class RunService:
             lines += [
                 "# TYPE attackfl_sched_queue_depth gauge",
                 f"attackfl_sched_queue_depth {snap['queue_depth']}",
+                "# TYPE attackfl_sched_running_jobs gauge",
+                f"attackfl_sched_running_jobs {snap['running_jobs']}",
                 "# TYPE attackfl_sched_backlog_seconds gauge",
                 f"attackfl_sched_backlog_seconds "
                 f"{snap['backlog_seconds']}",
@@ -370,6 +392,50 @@ class RunService:
                 f"attackfl_sched_circuit_broken_total "
                 f"{snap['circuit_broken_total']}",
             ]
+            if snap.get("waits_by_priority"):
+                lines.append(
+                    "# TYPE attackfl_sched_wait_seconds gauge")
+                for prio in sorted(snap["waits_by_priority"]):
+                    bucket = snap["waits_by_priority"][prio]
+                    tag = _sanitize(prio)
+                    for stat in ("p95", "max"):
+                        lines.append(
+                            f'attackfl_sched_wait_seconds'
+                            f'{{priority="{tag}",stat="{stat}"}} '
+                            f'{bucket[f"{stat}_seconds"]}')
+            # service-level SLO gauges (ISSUE 16): stitched from THIS
+            # daemon's own event stream, so the exported p95s cover the
+            # whole session, not just the jobs currently queued
+            try:
+                from attackfl_tpu.telemetry.fleet import slo_report
+                from attackfl_tpu.telemetry.summary import load_events
+
+                slo = slo_report(load_events(
+                    os.path.join(self.spool, SERVICE_EVENTS_NAME)))
+            except Exception:  # noqa: BLE001 — observational endpoint
+                slo = None
+            if slo is not None:
+                lines.append(
+                    "# TYPE attackfl_slo_queue_wait_p95_seconds gauge")
+                for prio in sorted(slo.get("queue_wait_p95_seconds", {})):
+                    lines.append(
+                        f'attackfl_slo_queue_wait_p95_seconds'
+                        f'{{priority="{_sanitize(prio)}"}} '
+                        f'{slo["queue_wait_p95_seconds"][prio]}')
+                lines += [
+                    "# TYPE attackfl_slo_preemption_rate gauge",
+                    f"attackfl_slo_preemption_rate "
+                    f"{slo['preemption_rate']}",
+                    "# TYPE attackfl_slo_shed_rate gauge",
+                    f"attackfl_slo_shed_rate {slo['shed_rate']}",
+                ]
+                if slo.get("starvation_bound_margin_seconds") is not None:
+                    lines += [
+                        "# TYPE attackfl_slo_starvation_bound_margin_"
+                        "seconds gauge",
+                        f"attackfl_slo_starvation_bound_margin_seconds "
+                        f"{slo['starvation_bound_margin_seconds']}",
+                    ]
         counters = self.telemetry.counters.snapshot()
         if counters:
             lines.append("# TYPE attackfl_counter counter")
@@ -393,6 +459,7 @@ class RunService:
         http.route("POST", "/cancel", self._route_cancel)
         http.route("GET", "/runs", self._route_runs)
         http.route("GET", "/schedule", self._route_schedule)
+        http.route("GET", "/fleet", self._route_fleet)
 
     def _route_jobs(self, query, body):
         return 200, {"jobs": [j.describe() for j in self.queue.jobs()]}
@@ -404,6 +471,24 @@ class RunService:
         if self.scheduler is None:
             return 404, {"error": "scheduler disabled (--no-scheduler)"}
         return 200, self.scheduler.snapshot()
+
+    def _route_fleet(self, query, body):
+        """The fleet observatory (ISSUE 16): the SLO report + the
+        per-tenant device-time ledger, stitched live from this daemon's
+        own spool.  Books only fully close once the session ends (the
+        wall clock keeps running), so ``books_close`` here is advisory;
+        the committed artifact comes from a finished session."""
+        try:
+            from attackfl_tpu.telemetry import fleet as fleet_mod
+
+            events = fleet_mod.load_service_events(self.spool)
+            return 200, {
+                "slo": fleet_mod.slo_report(events),
+                "ledger": fleet_mod.device_time_ledger(
+                    self.spool, events=events),
+            }
+        except Exception as e:  # noqa: BLE001 — observational endpoint
+            return 200, {"error": f"{type(e).__name__}: {e}"[:300]}
 
     def _route_status(self, query, body):
         job_id = query.get("job", "")

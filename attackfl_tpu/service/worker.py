@@ -205,8 +205,18 @@ class JobWorker(threading.Thread):
         if self.sched.get("preemptions") is not None:
             out["sched_preemptions"] = int(self.sched["preemptions"])
         if self.sched.get("wait_seconds") is not None:
+            # scheduler JSON (host value) — no float() coercion needed,
+            # and the service layer is a no-allowlist host-sync zone
             out["sched_wait_seconds"] = round(
-                float(self.sched["wait_seconds"]), 6)
+                self.sched["wait_seconds"], 6)
+        # schema v12 (ISSUE 16): the fleet-trace id + tenant + device
+        # slot join this run's header to the service's causal stream
+        if self.sched.get("fleet_id"):
+            out["sched_fleet_id"] = str(self.sched["fleet_id"])
+        if self.sched.get("slot") is not None:
+            out["sched_slot"] = int(self.sched["slot"])
+        if self.sched.get("tenant"):
+            out["sched_tenant"] = str(self.sched["tenant"])
         return out
 
     def _execute(self, resume: bool) -> dict[str, Any]:

@@ -133,6 +133,25 @@ Schema v11 (ISSUE 15) extends v10 — every v1-v10 file still validates:
   and the ledger mines all three (per-job wait/preemption accounting).
   Type-checked when present; v1-v10 headers carry none of them.
 
+Schema v12 (ISSUE 16) extends v11 — every v1-v11 file still validates:
+
+* ``slot`` — one device-slot occupancy transition from the scheduler
+  (:mod:`attackfl_tpu.scheduler`): ``slot`` (the 0-based slot index) +
+  ``action`` = acquire/release, with the occupant's identity riding as
+  optional typed fields (``job_id``, ``priority``, ``tenant``,
+  ``fleet_id``, and on release the measured ``busy_seconds``).  Paired
+  acquire/release records are what lets the fleet observatory
+  (:mod:`attackfl_tpu.telemetry.fleet`) close the books: Σ per-tenant
+  busy + measured idle ≈ wall × slots;
+* ``schedule`` events MAY carry ``fleet_id`` / ``slot`` / ``tenant`` —
+  every decision names the causal trace it belongs to and, for pack/
+  resume, the device slot it lands on;
+* ``run_header`` MAY carry ``sched_fleet_id`` / ``sched_slot`` /
+  ``sched_tenant`` — the dispatching scheduler stamps each run with its
+  fleet-trace id, slot and tenant, so a run's events join the fleet
+  timeline (and the ledger's per-tenant accounting) without guessing.
+  Type-checked when present; v1-v11 headers carry none of them.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -149,7 +168,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -217,6 +236,20 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # record per admit/pack/preempt/resume/shed/break, with the
     # decision's evidence as optional typed fields (below)
     "schedule": {"action": str},
+    # --- schema v12 kind (ISSUE 16) ---
+    # device-slot occupancy transition (attackfl_tpu/scheduler): the
+    # fleet observatory's busy/idle ground truth — one acquire when a
+    # job lands on a slot, one release (with the measured busy_seconds)
+    # when it leaves, whatever the reason (done/failed/preempt/drain)
+    "slot": {"slot": int, "action": str},
+}
+
+# --- schema v12: optional occupancy payload on `slot` events ---
+# (type-checked when present; a release carries the measured busy time
+# and the reason the slot came free; both carry the occupant identity)
+_OPTIONAL_SLOT_FIELDS: dict[str, Any] = {
+    "job_id": str, "priority": str, "tenant": str, "fleet_id": str,
+    "busy_seconds": _NUM, "reason": str,
 }
 
 # --- schema v11: optional evidence payload on `schedule` events ---
@@ -227,6 +260,9 @@ _OPTIONAL_SCHEDULE_FIELDS: dict[str, Any] = {
     "job_id": str, "priority": str, "predicted_seconds": _NUM,
     "backlog_seconds": _NUM, "retry_after_seconds": _NUM,
     "preemptions": int, "wait_seconds": _NUM, "reason": str,
+    # v12 (ISSUE 16): the causal-trace id every decision names, the
+    # device slot a pack/resume lands on, and the tenant it bills to
+    "fleet_id": str, "slot": int, "tenant": str,
 }
 
 # --- schema v9: optional cost payload on `program_profile` events ---
@@ -263,6 +299,10 @@ _OPTIONAL_RUN_HEADER_FIELDS: dict[str, Any] = {
     # the ledger mines all three for per-job accounting
     "sched_priority": str, "sched_preemptions": int,
     "sched_wait_seconds": _NUM,
+    # v12: fleet-trace provenance (ISSUE 16) — the causal id, device
+    # slot and tenant the dispatching scheduler stamped on the run, so
+    # a run directory's events join the fleet timeline by construction
+    "sched_fleet_id": str, "sched_slot": int, "sched_tenant": str,
 }
 
 # Which schema version introduced each kind.  The static-analysis
@@ -292,6 +332,10 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     # + optional run_header sched_* fields and the optional evidence
     # payload on the new kind itself
     11: frozenset({"schedule"}),
+    # + optional fleet_id/slot/tenant evidence on `schedule`, optional
+    # run_header sched_fleet_id/sched_slot/sched_tenant provenance, and
+    # the optional occupancy payload on the new kind itself
+    12: frozenset({"slot"}),
 }
 
 
@@ -406,6 +450,13 @@ def validate_event(record: Any) -> list[str]:
                                        or not isinstance(record[name], typ)):
                     errors.append(
                         f"[schedule] '{name}' has type "
+                        f"{type(record[name]).__name__}")
+        if kind == "slot":
+            for name, typ in _OPTIONAL_SLOT_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[slot] '{name}' has type "
                         f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:

@@ -15,6 +15,12 @@ streams by ``ts`` into one timeline and reports per-round cross-host skew:
   the cross-host imbalance, previously invisible because only process 0
   recorded anything.
 
+Since ISSUE 16 the same entry point also understands the run-service
+SPOOL layout: a directory holding ``service.events.jsonl`` and/or
+``jobs/<job_id>/events.jsonl`` per-job streams merges those by ``ts``
+instead, with each job event stamped with its ``job_id`` provenance —
+no more hand-assembled file lists to reconstruct a daemon session.
+
 Like :mod:`~attackfl_tpu.telemetry.summary` this is deliberately jax-free.
 """
 
@@ -27,6 +33,10 @@ from typing import Any
 from attackfl_tpu.telemetry.summary import load_events, percentile
 
 PROCESS_FILE_RE = re.compile(r"^events\.(\d+)\.jsonl$")
+# the run-service spool layout (attackfl_tpu/service/daemon.py)
+SERVICE_FILE = "service.events.jsonl"
+SERVICE_KEY = "service"
+JOBS_DIRNAME = "jobs"
 
 
 def find_process_files(path: str) -> list[tuple[int | None, str]]:
@@ -46,19 +56,60 @@ def find_process_files(path: str) -> list[tuple[int | None, str]]:
     return sorted(found, key=lambda item: (item[0] is not None, item[0] or 0))
 
 
+def is_spool(path: str) -> bool:
+    """A run-service spool: holds ``service.events.jsonl`` or a
+    ``jobs/`` directory, and no plain ``events.jsonl`` (a run directory
+    with one keeps the classic per-process merge)."""
+    return (os.path.isdir(path)
+            and not os.path.exists(os.path.join(path, "events.jsonl"))
+            and (os.path.exists(os.path.join(path, SERVICE_FILE))
+                 or os.path.isdir(os.path.join(path, JOBS_DIRNAME))))
+
+
+def find_spool_files(path: str) -> list[tuple[str, str]]:
+    """Event files of a service spool: the service stream (key
+    ``"service"``) plus every ``jobs/<job_id>/events.jsonl`` (key = the
+    job id), jobs sorted for a stable merge order."""
+    found: list[tuple[str, str]] = []
+    service = os.path.join(path, SERVICE_FILE)
+    if os.path.exists(service):
+        found.append((SERVICE_KEY, service))
+    jobs_dir = os.path.join(path, JOBS_DIRNAME)
+    if os.path.isdir(jobs_dir):
+        for job_id in sorted(os.listdir(jobs_dir)):
+            job_file = os.path.join(jobs_dir, job_id, "events.jsonl")
+            if os.path.exists(job_file):
+                found.append((job_id, job_file))
+    return found
+
+
 def merge_events(path: str) -> tuple[list[dict[str, Any]],
-                                     dict[int | None, int]]:
-    """Load every per-process file under ``path`` and interleave by ``ts``
-    (stable sort, so same-timestamp records keep file order).  Events
-    missing a ``process_index`` envelope field (v1 files) inherit the index
-    parsed from their filename.  Returns (merged, events-per-process)."""
-    per_process: dict[int | None, int] = {}
+                                     dict[int | str | None, int]]:
+    """Load every event file under ``path`` and interleave by ``ts``
+    (stable sort, so same-timestamp records keep file order).
+
+    Run directories merge ``events.<i>.jsonl`` per-process files, events
+    missing a ``process_index`` envelope field (v1 files) inheriting the
+    index parsed from their filename.  Service SPOOLS (ISSUE 16) merge
+    the service stream with every ``jobs/<id>/events.jsonl``, each job
+    event stamped with its ``job_id`` provenance.  Returns
+    (merged, events-per-source)."""
+    per_process: dict[int | str | None, int] = {}
     merged: list[dict[str, Any]] = []
-    for index, file_path in find_process_files(path):
+    if is_spool(path):
+        sources: list[tuple[int | str | None, str]] = list(
+            find_spool_files(path))
+    else:
+        sources = list(find_process_files(path))
+    for index, file_path in sources:
         events = [e for e in load_events(file_path)
                   if e.get("kind") != "_skipped"]
         for event in events:
-            event.setdefault("process_index", index)
+            if isinstance(index, str):
+                if index != SERVICE_KEY:
+                    event.setdefault("job_id", index)
+            else:
+                event.setdefault("process_index", index)
         per_process[index] = len(events)
         merged.extend(events)
     merged.sort(key=lambda e: e.get("ts") if isinstance(
@@ -136,14 +187,27 @@ def skew_summary(merged: list[dict[str, Any]]) -> dict[str, Any]:
     }
 
 
+def _source_label(key: int | str | None) -> str:
+    """One merge source's display name: per-process files by index, a
+    spool's service stream / per-job files by layout."""
+    if key is None:
+        return "events.jsonl"
+    if isinstance(key, int):
+        return f"events.{key}.jsonl"
+    if key == SERVICE_KEY:
+        return SERVICE_FILE
+    return f"{JOBS_DIRNAME}/{key}/events.jsonl"
+
+
 def format_merge_report(merged: list[dict[str, Any]],
-                        per_process: dict[int | None, int],
+                        per_process: dict[int | str | None, int],
                         skew: dict[str, Any]) -> str:
     lines = ["merged " + ", ".join(
-        f"events{'.' + str(i) if i is not None else ''}.jsonl"
-        f" ({n} events)" for i, n in sorted(
+        f"{_source_label(i)} ({n} events)" for i, n in sorted(
             per_process.items(),
-            key=lambda kv: (kv[0] is None, kv[0] or 0)))]
+            key=lambda kv: (kv[0] is None, isinstance(kv[0], str),
+                            kv[0] if isinstance(kv[0], int) else 0,
+                            str(kv[0]))))]
     for run_id, pids in skew["run_headers"].items():
         lines.append(f"run {run_id}: run_header from process(es) "
                      f"{pids or ['<single>']}")

@@ -423,7 +423,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="emit the summary as JSON instead of a table")
     parser.add_argument("--merge", action="store_true",
                         help="interleave per-process event files "
-                             "(multi-host run) and report round skew")
+                             "(multi-host run) or a service spool's "
+                             "service + per-job streams (each job event "
+                             "stamped with its job_id) and report round "
+                             "skew")
     parser.add_argument("--forensics", action="store_true",
                         help="defense detection quality (TPR/FPR) from "
                              "attribution events")

@@ -1,0 +1,146 @@
+#!/usr/bin/env bash
+# One-shot fleet-observatory smoke gate (ISSUE 16 tentpole), the sibling
+# of scripts/sched_smoke.sh: boots a REAL `attackfl-tpu serve` daemon,
+# runs the same contention scenario (1 low-priority 6-round job preempted
+# by 2 high-priority 1-round jobs), and asserts the fleet telemetry
+# closes end to end — the /metrics endpoint exports the scheduler + SLO
+# gauges, `fleet report` produces a non-empty SLO report whose per-tenant
+# device-time ledger CLOSES THE BOOKS (busy + idle = wall x slots within
+# 5%) with every run job joined to a cost-model prediction, and `fleet
+# trace` emits a Perfetto-loadable trace.json with queue-wait, preemption
+# and chunk spans for every job.  Used by tier-1 through
+# tests/test_scheduler.py; run it directly before sending a PR.
+#
+# Usage: scripts/fleet_smoke.sh [spool-dir]   (default: a fresh tmp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# share the persistent compile cache so repeat smokes skip the compile
+export ATTACKFL_COMPILE_CACHE="${ATTACKFL_COMPILE_CACHE:-/tmp/attackfl_jax_cache}"
+
+SPOOL="${1:-$(mktemp -d /tmp/attackfl_fleet_smoke.XXXXXX)}"
+mkdir -p "$SPOOL"
+LOW_CFG="$SPOOL/low.yaml"
+HIGH_CFG="$SPOOL/high.yaml"
+cat > "$LOW_CFG" <<'YAML'
+server:
+  num-round: 6
+  clients: 3
+  mode: fedavg
+  model: CNNModel
+  data-name: ICU
+  validation: false
+  train-size: 256
+  test-size: 128
+  random-seed: 1
+  data-distribution:
+    num-data-range: [48, 64]
+learning:
+  epoch: 1
+  batch-size: 32
+YAML
+# same shapes (shared compile cache), different seed + 1 round: the
+# high-priority jobs are short so the preempted job resumes quickly
+sed -e 's/num-round: 6/num-round: 1/' -e 's/random-seed: 1/random-seed: 2/' \
+    "$LOW_CFG" > "$HIGH_CFG"
+
+python -m attackfl_tpu serve --spool "$SPOOL" --port 0 \
+    --worker-backoff 0.2 &
+SERVE_PID=$!
+cleanup() { kill -9 "$SERVE_PID" 2>/dev/null || true; }
+trap cleanup EXIT
+
+echo "--- waiting for the control plane (spool: $SPOOL)"
+for _ in $(seq 1 150); do
+    [ -f "$SPOOL/service.json" ] && break
+    sleep 0.2
+done
+[ -f "$SPOOL/service.json" ] || { echo "service never came up" >&2; exit 1; }
+
+echo "--- submit: 1 low-priority (6 rounds) + 2 high-priority (1 round)"
+LOW=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+      --config "$LOW_CFG" --name smoke-low --priority low)
+echo "low job: $LOW"
+# let the low job actually occupy the slot (and outlive the scheduler's
+# min-runtime anti-thrash guard) before the high jobs contend for it
+for _ in $(seq 1 300); do
+    STATE=$(python -m attackfl_tpu job status "$LOW" --spool "$SPOOL" \
+            | python -c 'import json,sys; print(json.load(sys.stdin)["state"])')
+    [ "$STATE" = "running" ] && break
+    sleep 0.2
+done
+[ "$STATE" = "running" ] || { echo "low job never started" >&2; exit 1; }
+sleep 2
+HIGH1=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+        --config "$HIGH_CFG" --name smoke-high-1 --priority high)
+HIGH2=$(python -m attackfl_tpu job submit --spool "$SPOOL" \
+        --config "$HIGH_CFG" --name smoke-high-2 --priority high)
+echo "high jobs: $HIGH1 $HIGH2"
+
+echo "--- wait for all three (the low job must survive its preemption)"
+python -m attackfl_tpu job wait "$HIGH1" --spool "$SPOOL" --timeout 300
+python -m attackfl_tpu job wait "$HIGH2" --spool "$SPOOL" --timeout 300
+python -m attackfl_tpu job wait "$LOW" --spool "$SPOOL" --timeout 300
+
+echo "--- live gauges: scheduler + SLO families on /metrics"
+python - "$SPOOL" <<'PY'
+import json
+import sys
+import urllib.request
+
+spool = sys.argv[1]
+port = json.load(open(spool + "/service.json"))["port"]
+text = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+for family in ("attackfl_sched_queue_depth", "attackfl_sched_running_jobs",
+               "attackfl_slo_queue_wait_p95_seconds",
+               "attackfl_slo_preemption_rate", "attackfl_slo_shed_rate"):
+    assert family in text, f"{family} missing from /metrics"
+print("metrics: all scheduler + SLO gauge families exported")
+PY
+
+echo "--- SIGTERM drain -> clean exit"
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+trap - EXIT
+
+echo "--- fleet evidence: SLO report non-empty, books close, trace loads"
+python -m attackfl_tpu fleet report "$SPOOL"
+python -m attackfl_tpu fleet trace "$SPOOL" --out "$SPOOL/fleet.trace.json"
+python - "$SPOOL" "$LOW" "$HIGH1" "$HIGH2" <<'PY'
+import json
+import sys
+
+spool, low, high1, high2 = sys.argv[1:5]
+jobs = [low, high1, high2]
+
+from attackfl_tpu.telemetry.fleet import (
+    device_time_ledger, load_service_events, slo_report)
+
+events = load_service_events(spool)
+slo = slo_report(events)
+assert slo["jobs"] >= 3, slo
+assert slo["preemptions"] >= 1, slo
+assert slo["queue_wait_p95_seconds"].get("high") is not None, slo
+
+ledger = device_time_ledger(spool, events=events)
+assert ledger["books_close"], \
+    f"books do not close: {ledger['identity_error_pct']}% error"
+assert ledger["identity_error_pct"] <= 5.0, ledger["identity_error_pct"]
+joined = [j for j in ledger["jobs"] if j["prediction_error_factor"]]
+assert len(joined) == len(ledger["jobs"]) >= 3, \
+    f"cost-model join incomplete: {len(joined)}/{len(ledger['jobs'])}"
+
+trace = json.load(open(spool + "/fleet.trace.json"))
+ev = trace["traceEvents"]
+names = {e.get("name") for e in ev}
+assert any(e["ph"] == "X" and e.get("name") == "queue-wait" for e in ev)
+assert "preempted" in names, sorted(names)
+chunk_jobs = {e["args"]["job_id"] for e in ev
+              if e["ph"] == "X" and e.get("cat") == "chunk"}
+assert set(jobs) <= chunk_jobs, f"chunk spans missing: {chunk_jobs}"
+print(f"fleet: {len(ev)} trace events, books close at "
+      f"{ledger['identity_error_pct']}% error, "
+      f"{len(joined)} jobs cost-joined, p95 waits {slo['queue_wait_p95_seconds']}")
+PY
+echo "fleet smoke: OK"

@@ -32,6 +32,7 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/events.v9.jsonl" in names
     assert "tests/data/events.v10.jsonl" in names
     assert "tests/data/events.v11.jsonl" in names
+    assert "tests/data/events.v12.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 
@@ -122,3 +123,34 @@ def test_v11_scheduler_artifact_validates_standalone():
     assert all(isinstance(e["sched_wait_seconds"], float) for e in headers)
     ends = [e for e in events if e["kind"] == "run_end"]
     assert any(e.get("stop_reason") == "preempt" for e in ends)
+
+
+def test_v12_fleet_artifact_validates_standalone():
+    """The committed v12 corpus (ISSUE 16, from a real fleet_smoke
+    session): `slot` occupancy events validate, every schedule decision
+    carries the fleet-trace id + tenant the fleet observatory stitches
+    on, and the run headers join back via sched_fleet_id/sched_slot."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v12.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    slots = [e for e in events if e["kind"] == "slot"]
+    assert {e["action"] for e in slots} == {"acquire", "release"}
+    for event in slots:
+        assert event["schema"] == 12
+        assert isinstance(event["slot"], int)
+    releases = [e for e in slots if e["action"] == "release"]
+    assert any(e.get("busy_seconds", 0) > 0 for e in releases)
+    dispatch = [e for e in events if e["kind"] == "schedule"
+                and e["action"] in ("pack", "resume")]
+    assert dispatch
+    assert all(e["fleet_id"] and e["tenant"] and isinstance(e["slot"], int)
+               for e in dispatch)
+    headers = [e for e in events if e["kind"] == "run_header"
+               and "sched_fleet_id" in e]
+    assert headers, "v12 corpus must join run headers to the fleet trace"
+    fleet_ids = {e["fleet_id"] for e in dispatch}
+    assert all(e["sched_fleet_id"] in fleet_ids for e in headers)
+    assert all(isinstance(e["sched_slot"], int) for e in headers)

@@ -1,0 +1,337 @@
+"""Fleet observatory (ISSUE 16): causal stitching of the service stream
+into per-job timelines + slot occupancy spans, the per-tenant device-time
+ledger whose books must close (busy + idle = wall x slots), the SLO
+report, the Perfetto trace builder, the spool-aware merge, and the
+committed real-session artifacts (FLEET_SLO.json / FLEET_TRACE.json /
+tests/data/events.v12.jsonl).  All jax-free — these are pure-JSON tests.
+"""
+
+import json
+import os
+import pathlib
+
+from attackfl_tpu.telemetry.fleet import (
+    device_time_ledger, fleet_trace, job_timelines, load_service_events,
+    main as fleet_main, slo_report, slot_spans)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# one synthetic session, reused across the stitching tests: a single
+# slot, job A (low) preempted once by job B (high), both complete
+# ---------------------------------------------------------------------------
+
+def _session_events():
+    def ev(kind, ts, **fields):
+        return dict({"schema": 12, "kind": kind, "ts": ts}, **fields)
+
+    return [
+        ev("service", 0.0, action="started", slots=1, aging_rate=1.0,
+           starvation_bound_seconds=100.0, shed_horizon_seconds=0.0),
+        ev("job", 1.0, action="submitted", job_id="jobA", name="tenant-a",
+           seq=1),
+        ev("schedule", 1.1, action="admit", job_id="jobA", priority="low",
+           tenant="tenant-a", fleet_id="fa", predicted_seconds=30.0),
+        ev("slot", 2.0, action="acquire", slot=0, job_id="jobA",
+           tenant="tenant-a", priority="low", fleet_id="fa"),
+        ev("schedule", 2.0, action="pack", job_id="jobA", priority="low",
+           tenant="tenant-a", fleet_id="fa", slot=0, wait_seconds=1.0,
+           preemptions=0),
+        ev("job", 3.0, action="submitted", job_id="jobB", name="tenant-b",
+           seq=2),
+        ev("schedule", 3.1, action="admit", job_id="jobB", priority="high",
+           tenant="tenant-b", fleet_id="fb", predicted_seconds=10.0),
+        ev("schedule", 4.0, action="preempt", job_id="jobA", priority="low",
+           tenant="tenant-a", fleet_id="fa", reason="priority",
+           preemptions=1),
+        ev("slot", 10.0, action="release", slot=0, job_id="jobA",
+           tenant="tenant-a", priority="low", fleet_id="fa",
+           busy_seconds=8.0, reason="preempt"),
+        ev("job", 10.0, action="requeued", job_id="jobA", reason="preempt",
+           preemptions=1),
+        ev("slot", 10.5, action="acquire", slot=0, job_id="jobB",
+           tenant="tenant-b", priority="high", fleet_id="fb"),
+        ev("schedule", 10.5, action="pack", job_id="jobB", priority="high",
+           tenant="tenant-b", fleet_id="fb", slot=0, wait_seconds=7.5,
+           preemptions=0),
+        ev("slot", 30.0, action="release", slot=0, job_id="jobB",
+           tenant="tenant-b", priority="high", fleet_id="fb",
+           busy_seconds=19.5, reason="done"),
+        ev("job", 30.0, action="completed", job_id="jobB"),
+        ev("slot", 31.0, action="acquire", slot=0, job_id="jobA",
+           tenant="tenant-a", priority="low", fleet_id="fa"),
+        ev("schedule", 31.0, action="resume", job_id="jobA", priority="low",
+           tenant="tenant-a", fleet_id="fa", slot=0, wait_seconds=22.0,
+           preemptions=1),
+        ev("slot", 95.0, action="release", slot=0, job_id="jobA",
+           tenant="tenant-a", priority="low", fleet_id="fa",
+           busy_seconds=64.0, reason="done"),
+        ev("job", 95.0, action="completed", job_id="jobA"),
+        ev("service", 100.0, action="stopped"),
+    ]
+
+
+def _write_spool(tmp_path, events):
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    with open(spool / "service.events.jsonl", "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event) + "\n")
+    return str(spool)
+
+
+def test_job_timelines_stitch_the_causal_record():
+    jobs = job_timelines(_session_events())
+    a, b = jobs["jobA"], jobs["jobB"]
+    assert a["submitted_ts"] == 1.0 and a["admit_ts"] == 1.1
+    assert a["priority"] == "low" and a["tenant"] == "tenant-a"
+    assert a["fleet_id"] == "fa" and a["predicted_seconds"] == 30.0
+    assert [d["action"] for d in a["dispatches"]] == ["pack", "resume"]
+    assert a["preemptions"] == 1 and len(a["preempts"]) == 1
+    assert a["requeues"][0]["reason"] == "preempt"
+    assert a["end_action"] == "completed" and a["end_ts"] == 95.0
+    # final cumulative wait, not the first dispatch's
+    assert a["wait_seconds"] == 22.0
+    assert b["priority"] == "high" and b["preemptions"] == 0
+    assert b["wait_seconds"] == 7.5
+
+
+def test_slot_spans_pair_acquire_release():
+    spans = slot_spans(_session_events())
+    assert [(s["job_id"], s["start_ts"], s["end_ts"]) for s in spans] == [
+        ("jobA", 2.0, 10.0), ("jobB", 10.5, 30.0), ("jobA", 31.0, 95.0)]
+    assert spans[0]["reason"] == "preempt"
+    assert all(s["tenant"] and s["fleet_id"] for s in spans)
+
+
+def test_slot_spans_survive_tears():
+    # release with no acquire -> synthesized from busy_seconds; acquire
+    # with no release -> closed at until_ts
+    spans = slot_spans([
+        {"kind": "slot", "ts": 10.0, "action": "release", "slot": 0,
+         "job_id": "lost", "busy_seconds": 4.0, "tenant": "t"},
+        {"kind": "slot", "ts": 20.0, "action": "acquire", "slot": 1,
+         "job_id": "open", "tenant": "t"},
+    ], until_ts=50.0)
+    by_job = {s["job_id"]: s for s in spans}
+    assert by_job["lost"]["start_ts"] == 6.0
+    assert by_job["lost"]["reason"] == "unmatched"
+    assert by_job["open"]["end_ts"] == 50.0
+    assert by_job["open"]["reason"] == "open"
+
+
+def test_device_time_ledger_closes_the_books(tmp_path):
+    spool = _write_spool(tmp_path, _session_events())
+    ledger = device_time_ledger(spool)
+    assert ledger["wall_seconds"] == 100.0 and ledger["slots"] == 1
+    # busy 8 + 19.5 + 64 = 91.5; idle = 100 - union = 8.5; identity exact
+    assert ledger["busy_seconds_total"] == 91.5
+    assert ledger["idle_seconds_total"] == 8.5
+    assert ledger["identity_error_pct"] == 0.0
+    assert ledger["books_close"] is True
+    tenants = ledger["tenants"]
+    assert tenants["tenant-a"]["busy_seconds"] == 72.0
+    assert tenants["tenant-a"]["spans"] == 2
+    assert tenants["tenant-b"]["share_of_busy"] == round(19.5 / 91.5, 4)
+    # every run job is joined to its cost-model prediction
+    jobs = {j["job_id"]: j for j in ledger["jobs"]}
+    assert jobs["jobA"]["prediction_error_factor"] == round(72 / 30, 4)
+    assert jobs["jobB"]["predicted_seconds"] == 10.0
+    assert all(j["prediction_error_factor"] for j in ledger["jobs"])
+
+
+def test_device_time_ledger_double_booking_breaks_the_identity(tmp_path):
+    # two jobs billed to the SAME slot at the same time: busy inflates
+    # but idle (union-based) does not shrink -> the identity tears open
+    events = [e for e in _session_events()
+              if not (e["kind"] == "slot" and e["job_id"] == "jobB")]
+    events.insert(4, {"schema": 12, "kind": "slot", "ts": 2.0,
+                      "action": "acquire", "slot": 0, "job_id": "jobB",
+                      "tenant": "tenant-b"})
+    events.insert(5, {"schema": 12, "kind": "slot", "ts": 95.0,
+                      "action": "release", "slot": 0, "job_id": "jobB",
+                      "tenant": "tenant-b", "reason": "done"})
+    ledger = device_time_ledger(_write_spool(tmp_path, events))
+    assert ledger["identity_error_pct"] > 5.0
+    assert ledger["books_close"] is False
+
+
+def test_slo_report_gauges():
+    slo = slo_report(_session_events())
+    assert slo["jobs"] == 2 and slo["jobs_dispatched"] == 2
+    assert slo["admits"] == 2
+    assert slo["queue_wait_p95_seconds"] == {"high": 7.5, "low": 22.0}
+    assert slo["queue_wait_max_seconds"]["low"] == 22.0
+    assert slo["preemptions"] == 1 and slo["preemption_rate"] == 0.5
+    assert slo["sheds"] == 0 and slo["shed_rate"] == 0.0
+    assert slo["starvation_bound_seconds"] == 100.0
+    assert slo["starvation_bound_margin_seconds"] == 78.0
+
+
+def test_slo_report_empty_stream_is_zeros_not_holes():
+    slo = slo_report([])
+    assert slo["jobs"] == 0 and slo["jobs_dispatched"] == 0
+    assert slo["queue_wait_p95_seconds"] == {}
+    assert slo["preemption_rate"] == 0.0 and slo["shed_rate"] == 0.0
+
+
+def test_fleet_trace_chrome_shape(tmp_path):
+    spool = _write_spool(tmp_path, _session_events())
+    # give jobA an execution stream so the trace carries chunk spans
+    job_dir = tmp_path / "spool" / "jobs" / "jobA"
+    job_dir.mkdir(parents=True)
+    with open(job_dir / "events.jsonl", "w") as fh:
+        fh.write(json.dumps({"schema": 12, "kind": "chunk", "ts": 6.0,
+                             "seconds": 3.5, "chunk_len": 4,
+                             "includes_compile": True}) + "\n")
+        fh.write(json.dumps({"schema": 12, "kind": "round", "ts": 9.0,
+                             "seconds": 1.0, "round": 5, "ok": True}) + "\n")
+    trace = fleet_trace(spool)
+    assert trace["displayTimeUnit"] == "ms"
+    ev = trace["traceEvents"]
+    meta = {(e["pid"], e.get("tid")): e["args"]["name"]
+            for e in ev if e["ph"] == "M"}
+    assert meta[(1, None)] == "device slots" and meta[(2, None)] == "jobs"
+    assert meta[(1, 0)] == "slot 0"
+    slot_spans_ = [e for e in ev if e["ph"] == "X" and e["cat"] == "slot"]
+    assert [e["name"] for e in slot_spans_] == [
+        "tenant-a", "tenant-b", "tenant-a"]
+    names = {e["name"] for e in ev if e["ph"] == "X"}
+    assert {"queue-wait", "preempted", "run", "run (resumed)",
+            "chunk[4]", "round 5"} <= names
+    # the preemption gap covers requeue(10.0) -> resume(31.0)
+    gap = next(e for e in ev if e.get("name") == "preempted")
+    assert gap["ts"] == 10_000_000 and gap["dur"] == 21_000_000
+    chunk = next(e for e in ev if e.get("name") == "chunk[4]")
+    assert chunk["ts"] == 2_500_000 and chunk["dur"] == 3_500_000
+    instants = {e["name"] for e in ev if e["ph"] == "i"}
+    assert "preempt requested" in instants
+
+
+def test_fleet_cli_report_and_trace(tmp_path, capsys):
+    spool = _write_spool(tmp_path, _session_events())
+    assert fleet_main(["report", spool]) == 0
+    out = capsys.readouterr().out
+    assert "CLOSED" in out and "tenant-a" in out and "p95" in out
+    assert fleet_main(["report", spool, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ledger"]["books_close"] is True
+    assert payload["slo"]["preemptions"] == 1
+    out_path = tmp_path / "t.json"
+    assert fleet_main(["trace", spool, "--out", str(out_path)]) == 0
+    trace = json.loads(out_path.read_text())
+    assert trace["traceEvents"]
+    # an empty spool reports a miss instead of stack-tracing
+    assert fleet_main(["report", str(tmp_path / "nope")]) == 2
+
+
+def test_merge_learns_the_spool_layout(tmp_path):
+    from attackfl_tpu.telemetry import merge as merge_mod
+
+    spool = _write_spool(tmp_path, _session_events())
+    for job_id, ts in (("jobA", 5.0), ("jobB", 15.0)):
+        job_dir = tmp_path / "spool" / "jobs" / job_id
+        job_dir.mkdir(parents=True)
+        with open(job_dir / "events.jsonl", "w") as fh:
+            fh.write(json.dumps({"schema": 12, "kind": "round", "ts": ts,
+                                 "round": 1, "ok": True,
+                                 "seconds": 1.0}) + "\n")
+    assert merge_mod.is_spool(spool)
+    merged, sources = merge_mod.merge_events(spool)
+    assert set(sources) == {merge_mod.SERVICE_KEY, "jobA", "jobB"}
+    ts_order = [e["ts"] for e in merged]
+    assert ts_order == sorted(ts_order)
+    rounds = [e for e in merged if e["kind"] == "round"]
+    assert [r["job_id"] for r in rounds] == ["jobA", "jobB"]
+    # service events keep their shape — no job_id stamped on them
+    assert "job_id" not in next(e for e in merged if e["kind"] == "service")
+
+
+def test_parse_prom_reads_back_metrics_text():
+    from attackfl_tpu.cli import _parse_prom
+
+    gauges = _parse_prom(
+        "# TYPE attackfl_sched_queue_depth gauge\n"
+        "attackfl_sched_queue_depth 3\n"
+        'attackfl_slo_queue_wait_p95_seconds{priority="high"} 1.25\n'
+        "attackfl_bogus not-a-number\n")
+    assert gauges["attackfl_sched_queue_depth"] == 3.0
+    assert gauges[
+        'attackfl_slo_queue_wait_p95_seconds{priority="high"}'] == 1.25
+    assert "attackfl_bogus" not in gauges
+
+
+def test_prediction_error_factor():
+    from attackfl_tpu.costmodel.estimate import prediction_error_factor
+
+    assert prediction_error_factor(30.0, 15.0) == 2.0
+    assert prediction_error_factor(15.0, 30.0) == 2.0  # symmetric
+    assert prediction_error_factor(None, 30.0) is None
+    assert prediction_error_factor(30.0, 0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# the committed real-session artifacts (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_committed_fleet_slo_books_close():
+    """FLEET_SLO.json — from a real fleet_smoke daemon session: the
+    accounting identity holds within 5% and every run job is joined to a
+    cost-model prediction."""
+    payload = json.loads((REPO / "FLEET_SLO.json").read_text())
+    ledger = payload["ledger"]
+    assert ledger["books_close"] is True
+    assert ledger["identity_error_pct"] <= 5.0
+    total = ledger["busy_seconds_total"] + ledger["idle_seconds_total"]
+    assert abs(total - ledger["capacity_seconds"]) <= (
+        0.05 * ledger["capacity_seconds"])
+    assert len(ledger["jobs"]) >= 3
+    assert all(j["prediction_error_factor"] is not None
+               for j in ledger["jobs"])
+    assert sum(1 for j in ledger["jobs"] if j["preemptions"]) >= 1
+    slo = payload["slo"]
+    assert slo["preemptions"] >= 1
+    assert set(slo["queue_wait_p95_seconds"]) >= {"high", "low"}
+
+
+def test_committed_fleet_trace_loads():
+    """FLEET_TRACE.json — same session: Chrome-format events with
+    queue-wait, preemption-gap and chunk spans for every job."""
+    trace = json.loads((REPO / "FLEET_TRACE.json").read_text())
+    ev = trace["traceEvents"]
+    assert all(e["ph"] in ("M", "X", "i") for e in ev)
+    assert all(e["ts"] >= 0 and e["dur"] >= 1
+               for e in ev if e["ph"] == "X")
+    job_ids = {e["args"]["job_id"] for e in ev
+               if e["ph"] == "X" and e.get("cat") in ("wait", "run")}
+    assert len(job_ids) >= 3
+    waited = {e["args"]["job_id"] for e in ev
+              if e.get("name") == "queue-wait"}
+    chunked = {e["args"]["job_id"] for e in ev
+               if e["ph"] == "X" and e.get("cat") == "chunk"}
+    assert job_ids <= waited and job_ids <= chunked
+    assert any(e.get("name") == "preempted" for e in ev)
+
+
+def test_committed_v12_corpus_round_trips_the_observatory():
+    """The stitchers run end to end over the committed v12 corpus: a
+    spool reassembled from it yields a closing ledger and a non-empty
+    SLO report (the corpus carries the full causal chain)."""
+    events = [json.loads(line)
+              for line in (REPO / "tests" / "data"
+                           / "events.v12.jsonl").open()]
+    service = [e for e in events
+               if e["kind"] in ("service", "job", "schedule", "slot")]
+    slo = slo_report(service)
+    assert slo["jobs"] >= 3 and slo["preemptions"] >= 1
+
+
+def test_load_service_events_drops_skip_sentinel(tmp_path):
+    spool = tmp_path / "s"
+    spool.mkdir()
+    (spool / "service.events.jsonl").write_text(
+        json.dumps({"schema": 12, "kind": "service", "ts": 1.0,
+                    "action": "started"}) + "\nnot-json\n")
+    events = load_service_events(str(spool))
+    assert [e["kind"] for e in events] == ["service"]

@@ -566,3 +566,54 @@ def test_regress_sh_gate_passes_on_committed_corpus():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ledger regress gate: OK" in proc.stdout
     assert "REGRESSION" in proc.stdout  # the synthetic slowdown WAS flagged
+
+
+# ---------------------------------------------------------------------------
+# scheduler accounting in the ledger (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_compare_records_surfaces_sched_accounting():
+    old = {"record_id": "a", "fingerprint": "f", "executor": "sync",
+           "sched_priority": "low", "sched_wait_seconds": 2.0,
+           "sched_preemptions": 0}
+    new = {"record_id": "b", "fingerprint": "f", "executor": "sync",
+           "sched_priority": "high", "sched_wait_seconds": 6.0,
+           "sched_preemptions": 2}
+    diff = compare_records(old, new)
+    assert diff["sched"]["priority"] == {"old": "low", "new": "high"}
+    assert diff["sched"]["wait_seconds"]["delta"] == 4.0
+    assert diff["sched"]["preemptions"]["delta"] == 2.0
+    # records with no sched provenance don't grow a noise section
+    assert compare_records({"record_id": "a"}, {"record_id": "b"})[
+        "sched"] is None
+
+
+def test_rolling_baseline_pools_peer_queue_waits():
+    peers = [{"record_id": f"p{i}", "fingerprint": "f", "executor": "sync",
+              "source": "run", "rounds_per_sec_steady": 1.0,
+              "sched_wait_seconds": w}
+             for i, w in enumerate([1.0, 2.0, 3.0])]
+    candidate = {"record_id": "c", "fingerprint": "f", "executor": "sync",
+                 "source": "run", "rounds_per_sec_steady": 1.0}
+    baseline = rolling_baseline(peers + [candidate], candidate)
+    assert baseline["sched_wait_peers"] == [1.0, 2.0, 3.0]
+    assert baseline["sched_wait_seconds"] == 2.0  # median
+
+
+def test_regress_queue_wait_gate_is_noise_floored():
+    """The sched:queue_wait_p95 gate: a candidate inside the floor
+    passes even at +100%; one far beyond the stretched p95 fails."""
+    baseline = {"record_id": "b", "fingerprint": "f", "executor": "sync",
+                "rounds_per_sec_steady": 1.0,
+                "sched_wait_peers": [1.0, 2.0, 3.0]}
+    ok = dict(baseline, record_id="ok", sched_wait_seconds=6.0)
+    # p95 ~= 2.9; allowed = max(2.9 * 2, 2.9 + 5) ~= 7.9 -> 6.0 passes
+    verdict = regress_check(baseline, ok)
+    assert verdict["ok"], verdict
+    bad = dict(baseline, record_id="bad", sched_wait_seconds=60.0)
+    verdict = regress_check(baseline, bad)
+    checks = {v["check"] for v in verdict["violations"]}
+    assert "sched:queue_wait_p95" in checks
+    violation = next(v for v in verdict["violations"]
+                     if v["check"] == "sched:queue_wait_p95")
+    assert violation["candidate"] == 60.0 and violation["peers"] == 3

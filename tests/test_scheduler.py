@@ -912,6 +912,21 @@ def test_sched_smoke_script():
     assert "sched smoke: OK" in result.stdout
 
 
+def test_fleet_smoke_script():
+    """scripts/fleet_smoke.sh — the tier-1 fleet-observatory gate
+    (ISSUE 16) against a real daemon: /metrics exports the scheduler +
+    SLO gauges, the device-time books close within 5%, and the fleet
+    trace carries queue-wait / preemption / chunk spans for every job."""
+    result = subprocess.run(
+        ["bash", str(REPO / "scripts" / "fleet_smoke.sh")],
+        cwd=str(REPO), env=_daemon_env(), capture_output=True, text=True,
+        timeout=420)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "fleet smoke: OK" in result.stdout
+    assert "CLOSED" in result.stdout
+
+
 def test_tick_change_detection_skips_redundant_rescans(tmp_path):
     """A saturated slot must not pay a full sealed-entry queue rescan
     per poll interval: with no durable mutation, no worker-set change
