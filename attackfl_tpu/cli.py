@@ -678,6 +678,19 @@ def fleet_main(argv=None) -> int:
     return _fleet_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def science_main(argv=None) -> int:
+    """``attackfl-tpu science``: the scenario science observatory
+    (ISSUE 17) — ``leaderboard`` ranks defenses by attack damage
+    (clean-baseline quality minus cell quality, bootstrap-over-seeds
+    CIs), ``report`` writes the auditable SCOREBOARD.json, ``diff
+    --gate`` is the rank-stability CI hook (exit 1 when a ranking flips
+    or damage regresses beyond the inter-seed noise floor).  Jax-free,
+    like ``ledger``."""
+    from attackfl_tpu.science.cli import main as _science_main
+
+    return _science_main(list(sys.argv[1:] if argv is None else argv))
+
+
 def ledger_main(argv=None) -> int:
     """``attackfl-tpu ledger``: the persistent cross-run store —
     ``list``/``show`` query it, ``compare`` diffs two runs (or a run
@@ -702,6 +715,7 @@ _SUBCOMMANDS = {
     "serve": serve_main,
     "job": job_main,
     "fleet": fleet_main,
+    "science": science_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -734,6 +748,11 @@ commands:
            device-time ledger (busy + idle = wall x slots) + SLO gauges;
            trace = one Perfetto-loadable cross-job trace (slot occupancy,
            queue waits, preemption gaps, chunk spans)
+  science  scenario science over matrix sweeps: leaderboard = defense
+           robustness ranking by attack damage (clean 'none' baseline,
+           bootstrap CIs); report = auditable SCOREBOARD.json; diff
+           --gate = rank-stability CI hook (exit 1 past the inter-seed
+           noise floor)
 """
 
 

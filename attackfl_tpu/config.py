@@ -41,6 +41,15 @@ AGGREGATION_MODES = (
 
 ATTACK_MODES = ("Random", "Min-Max", "Min-Sum", "Opt-Fang", "LIE")
 
+# The clean-baseline sentinel on the matrix attack axis (ISSUE 17): an
+# attacker cohort that never fires.  Not in ATTACK_MODES — the five real
+# attacks stay the default sweep axis — but AttackSpec accepts it, so a
+# `none` cell keeps the SAME cohort geometry (the configured attackers
+# are still excluded from the genuine leak pool) while every client
+# trains genuinely every round.  That makes attack damage a paired
+# measurement: `none` vs attacked cells differ ONLY in the attack.
+NONE_ATTACK = "none"
+
 # Hard ceiling on the pipelined executor's in-flight round queue: beyond
 # this, each extra slot only adds device-state residency (one full state
 # pytree per slot when checkpointing) without host latency left to hide.
@@ -289,8 +298,11 @@ class AttackSpec:
     args: tuple[float, ...] = ()
 
     def __post_init__(self):
-        if self.mode not in ATTACK_MODES:
-            raise ValueError(f"Unknown attack mode {self.mode!r}; choose from {ATTACK_MODES}")
+        if self.mode not in ATTACK_MODES and self.mode != NONE_ATTACK:
+            raise ValueError(
+                f"Unknown attack mode {self.mode!r}; choose from "
+                f"{ATTACK_MODES} (or {NONE_ATTACK!r} for a clean-baseline "
+                "cohort that never fires)")
         # normalize args to floats HERE so every producer (YAML, CLI,
         # matrix grids) yields identical specs — and identical config
         # fingerprints — for e.g. `args: [50, 1]` vs `args: [50.0, 1.0]`

@@ -304,6 +304,38 @@ def format_regress(verdict: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def sweep_rollup(records: list[dict[str, Any]], sweep_id: str) -> str:
+    """One-line sweep summary for ``ledger list --sweep``: cell
+    completion (a cell with lost rounds was quarantined by the per-cell
+    retry budget or cut by an interruption) + median final quality.
+    Reads FULL records — the index carries no quality columns."""
+    import statistics
+
+    from attackfl_tpu.science.outcomes import pick_quality_key
+
+    cells = [r for r in records if r.get("source") == "matrix"
+             and r.get("sweep_id") == sweep_id]
+    if not cells:
+        return f"sweep {sweep_id}: no cell records"
+    done = sum(
+        1 for r in cells
+        if isinstance(r.get("ok_rounds"), int)
+        and isinstance(r.get("rounds"), int)
+        and r["rounds"] > 0 and r["ok_rounds"] >= r["rounds"])
+    quality_key = pick_quality_key(cells)
+    line = (f"sweep {sweep_id}: {len(cells)} cell(s), {done} complete, "
+            f"{len(cells) - done} quarantined/cut")
+    if quality_key:
+        values = [
+            (r.get("final") or {}).get(quality_key) for r in cells]
+        values = [v for v in values if isinstance(v, (int, float))
+                  and not isinstance(v, bool)]
+        if values:
+            line += (f", median {quality_key} "
+                     f"{statistics.median(values):.4f}")
+    return line
+
+
 def _store(args) -> LedgerStore:
     # an explicit --dir beats the env var (the user typed it); without
     # one, fall back to $ATTACKFL_LEDGER_DIR then ./ledger
@@ -334,6 +366,9 @@ def main(argv: list[str] | None = None) -> int:
                             help="index of every recorded run")
     p_list.add_argument("--fingerprint", type=str, default=None)
     p_list.add_argument("--executor", type=str, default=None)
+    p_list.add_argument("--sweep", type=str, default=None,
+                        help="only this matrix sweep's cell records, "
+                             "plus a one-line completion/quality rollup")
     p_list.add_argument("--json", action="store_true")
 
     p_show = sub.add_parser("show", parents=[common],
@@ -361,6 +396,11 @@ def main(argv: list[str] | None = None) -> int:
     p_reg.add_argument("--threshold-pct", type=float, default=None,
                        help="steady-rounds/s slowdown that fails "
                             "(default 10; noise-floored)")
+    p_reg.add_argument("--sweeps", nargs=2, metavar=("OLD", "NEW"),
+                       default=None,
+                       help="rank-stability gate between two matrix "
+                            "sweeps instead of a record pair (delegates "
+                            "to `science diff --gate`)")
     p_reg.add_argument("--json", action="store_true")
 
     p_imp = sub.add_parser("import", parents=[common],
@@ -379,6 +419,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.executor:
             entries = [e for e in entries
                        if e.get("executor") == args.executor]
+        if args.sweep:
+            entries = [e for e in entries
+                       if e.get("sweep_id") == args.sweep]
         if args.json:
             print(json.dumps(entries, indent=1))
         elif not entries:
@@ -386,6 +429,9 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         else:
             print(format_list(entries))
+            if args.sweep:
+                records, _ = store.load()
+                print(sweep_rollup(records, args.sweep))
         return 0
 
     if args.command == "show":
@@ -409,6 +455,15 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(diff, indent=1) if args.json
               else format_compare(diff))
         return 0
+
+    if args.command == "regress" and args.sweeps:
+        # the ISSUE 17 rank gate rides the familiar CI entry point
+        from attackfl_tpu.science.cli import main as science_main
+
+        return science_main(
+            ["diff", args.sweeps[0], args.sweeps[1], "--gate"]
+            + (["--dir", args.dir] if args.dir else [])
+            + (["--json"] if args.json else []))
 
     if args.command == "regress":
         records, _ = store.load()

@@ -151,22 +151,31 @@ def status_main(argv: list[str] | None = None) -> int:
     if args.json:
         print(json.dumps(cells, indent=1))
         return 0
+    # the science join supplies the quality/damage columns (ISSUE 17):
+    # damage = the cell's `none`-baseline quality minus its own
+    from attackfl_tpu.science.outcomes import outcome_rows
+
+    joined = {row["cell"]: row
+              for row in outcome_rows(cells, sweep_id=sweep_id)}
     print(f"sweep {sweep_id}: {len(cells)} cell record(s)")
     print(f"{'cell':<30}{'rounds':>8}{'ok':>5}{'roc_auc':>9}"
-          f"{'accuracy':>10}{'loss':>9}")
+          f"{'accuracy':>10}{'loss':>9}{'quality':>9}{'damage':>9}")
     for record in cells:
         final = record.get("final") or {}
+        row = joined.get(record.get("cell")) or {}
 
-        def fmt(key: str) -> str:
-            value = final.get(key)
+        def fmt(value) -> str:
             return (f"{value:.4f}" if isinstance(value, (int, float))
                     and not isinstance(value, bool) else "-")
 
         print(f"{str(record.get('cell'))[:29]:<30}"
               f"{record.get('rounds', 0):>8}"
               f"{record.get('ok_rounds', 0):>5}"
-              f"{fmt('roc_auc'):>9}{fmt('accuracy'):>10}"
-              f"{fmt('train_loss'):>9}")
+              f"{fmt(final.get('roc_auc')):>9}"
+              f"{fmt(final.get('accuracy')):>10}"
+              f"{fmt(final.get('train_loss')):>9}"
+              f"{fmt(row.get('quality')):>9}"
+              f"{fmt(row.get('damage')):>9}")
     return 0
 
 

@@ -30,6 +30,65 @@ from attackfl_tpu.utils.fingerprint import config_fingerprint
 _QUALITY_KEYS = ("roc_auc", "accuracy", "nll", "train_loss")
 
 
+def summarize_cell_events(events: list[dict[str, Any]]
+                          ) -> dict[str, Any]:
+    """Forensics / numerics / lifecycle-count blocks for ONE cell's
+    event slice, shaped exactly like ``derive_record``'s
+    (:mod:`attackfl_tpu.ledger.record`) so the science outcome join
+    reads matrix cells and standalone runs with one code path.  Returns
+    ``{}`` when the slice measured nothing (telemetry off, batched cell
+    without numerics, pre-v13 artifact)."""
+    from attackfl_tpu.telemetry.forensics import forensics_summary
+    from attackfl_tpu.telemetry.numerics import numerics_summary
+
+    out: dict[str, Any] = {}
+    forensics = forensics_summary(events)
+    if forensics is not None:
+        out["forensics"] = {k: forensics.get(k) for k in
+                            ("tpr", "fpr", "precision", "rounds",
+                             "attack_rounds", "rollbacks")}
+    numerics = numerics_summary(events)
+    if numerics is not None:
+        numerics_out: dict[str, Any] = {
+            "rounds": numerics.get("rounds"),
+            "nonfinite_total": numerics.get("nonfinite_total"),
+            **(numerics.get("final") or {}),
+        }
+        separation = numerics.get("separation")
+        if separation:
+            numerics_out["sep_margin_mean"] = separation.get("margin_mean")
+            numerics_out["sep_margin_min"] = separation.get("margin_min")
+        out["numerics"] = numerics_out
+    counts = {
+        "rollbacks": sum(1 for e in events
+                         if e.get("kind") == "rollback"),
+        "degrades": sum(1 for e in events if e.get("kind") == "degrade"),
+    }
+    if any(counts.values()):
+        out["counts"] = counts
+    return out
+
+
+def cell_event_summaries(events: list[dict[str, Any]]
+                         ) -> dict[str, dict[str, Any]]:
+    """Group a sweep spool's events by their ``cell`` stamp and
+    summarize each slice.  Batched cells' drainer events arrive already
+    stamped (``matrix_exec._CellTelemetry``); a fallback cell's own
+    spool is not — the executor stamps those at read time before
+    calling this."""
+    by_cell: dict[str, list[dict[str, Any]]] = {}
+    for event in events:
+        cell = event.get("cell")
+        if isinstance(cell, str):
+            by_cell.setdefault(cell, []).append(event)
+    out: dict[str, dict[str, Any]] = {}
+    for cell, chunk in by_cell.items():
+        summary = summarize_cell_events(chunk)
+        if summary:
+            out[cell] = summary
+    return out
+
+
 def _final_quality(history: list[dict[str, Any]]) -> dict[str, float]:
     final: dict[str, float] = {}
     for entry in history:
@@ -56,6 +115,7 @@ def cell_record(
     resumed: bool = False,
     provenance: dict[str, Any] | None = None,
     programs: dict[str, Any] | None = None,
+    event_summary: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """One cell's ledger record (``ledger_schema`` 1, ``source``
     "matrix").  ``wall_s`` is the SWEEP wall clock: cells share every
@@ -64,7 +124,11 @@ def cell_record(
     ``programs`` (ISSUE 11) is the sweep's program-profile capture — the
     grid program covers every device cell, so each cell record carries
     the SHARED profile (flops/bytes/peak memory of the whole grid
-    dispatch), folded into a static ``utilization`` block."""
+    dispatch), folded into a static ``utilization`` block.
+    ``event_summary`` (ISSUE 17) is :func:`summarize_cell_events`'s
+    output for this cell — forensics/numerics blocks plus extra
+    lifecycle counts, merged in so the science outcome join sees the
+    same columns a standalone run's record carries."""
     cfg = cell_config(base_cfg, cell, rounds=rounds)
     ok_rounds = sum(1 for h in history if h.get("ok"))
     amortized = wall_s / max(n_cells, 1)
@@ -97,6 +161,11 @@ def cell_record(
         },
         "final": _final_quality(history),
     }
+    if event_summary:
+        for section in ("forensics", "numerics"):
+            if event_summary.get(section):
+                record[section] = dict(event_summary[section])
+        record["counts"].update(event_summary.get("counts") or {})
     if programs:
         from attackfl_tpu.costmodel.roofline import utilization_summary
 
@@ -124,13 +193,16 @@ def sweep_records(
     resumed: bool = False,
     provenance: dict[str, Any] | None = None,
     programs: dict[str, Any] | None = None,
+    event_summaries: dict[str, dict[str, Any]] | None = None,
 ) -> list[dict[str, Any]]:
     """Records for every cell that has a history, in grid order."""
+    summaries = event_summaries or {}
     return [
         cell_record(
             sweep_id=sweep_id, cell=cell, base_cfg=base_cfg, rounds=rounds,
             history=histories.get(cell.key) or [], run_id=run_id, ts=ts,
             wall_s=wall_s, n_cells=len(cells), resumed=resumed,
-            provenance=provenance, programs=programs)
+            provenance=provenance, programs=programs,
+            event_summary=summaries.get(cell.key))
         for cell in cells if cell.key in histories
     ]

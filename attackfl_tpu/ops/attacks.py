@@ -170,6 +170,12 @@ def apply_attack(
     enforces that with a static leak count.
     """
     num_leaked = jax.tree.leaves(genuine_stacked)[0].shape[0] if genuine_stacked is not None else 0
+    if mode == "none":
+        # clean-baseline sentinel (ISSUE 17): never fires.  round_step
+        # skips `none` groups before the leak gather, so this branch only
+        # serves direct callers — the honest no-op is the attacker's own
+        # (genuinely trained) params.
+        return own_params
     if mode == "Random":
         sigma = args[0] if args else DEFAULT_RANDOM_SIGMA
         return random_attack(own_params, rng, sigma)

@@ -1,0 +1,22 @@
+"""Scenario science observatory (ISSUE 17).
+
+The paper's experimental object is the attack × defense outcome surface;
+a matrix sweep leaves k×45 per-cell ledger records that, until this
+package, nothing joined, ranked, or gated.  Three layers, all jax-free
+(they read JSON and do arithmetic, like the rest of the ledger CLI):
+
+* :mod:`~attackfl_tpu.science.outcomes` — the outcome join: ledger
+  records -> one tidy row per cell (attack, defense, seed, quality,
+  **damage** = clean-baseline quality minus cell quality, forensics
+  TPR/FPR/precision, rollback/degrade counts, numerics separation
+  margins);
+* :mod:`~attackfl_tpu.science.rank` — per-defense robustness
+  leaderboards with bootstrap-over-seeds confidence intervals,
+  per-attack effectiveness, worst-case rankings, Kendall-tau rank
+  stability between sweeps, and the rank-regression gate whose noise
+  floor derives from inter-seed spread (the PR-7 paired-means lesson:
+  the gate never outruns its own noise);
+* :mod:`~attackfl_tpu.science.cli` — ``attackfl-tpu science
+  leaderboard|report|diff`` (``diff --gate`` is the CI hook; exit 1 on
+  a rank flip or damage regression beyond the noise floor).
+"""

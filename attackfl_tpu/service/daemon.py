@@ -460,6 +460,7 @@ class RunService:
         http.route("GET", "/runs", self._route_runs)
         http.route("GET", "/schedule", self._route_schedule)
         http.route("GET", "/fleet", self._route_fleet)
+        http.route("GET", "/science", self._route_science)
 
     def _route_jobs(self, query, body):
         return 200, {"jobs": [j.describe() for j in self.queue.jobs()]}
@@ -489,6 +490,41 @@ class RunService:
             }
         except Exception as e:  # noqa: BLE001 — observational endpoint
             return 200, {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    def _route_science(self, query, body):
+        """The scenario science observatory (ISSUE 17): the defense
+        leaderboard of the newest matrix sweep in the shared ledger
+        (``?sweep=<id>`` pins one; prefixes resolve when unambiguous).
+        Jax-free and fail-open, like ``/fleet``."""
+        try:
+            from attackfl_tpu.ledger.store import LedgerStore
+            from attackfl_tpu.science.outcomes import (
+                outcome_rows, sweep_ids,
+            )
+            from attackfl_tpu.science.rank import leaderboard
+
+            store = LedgerStore(self.ledger_dir)
+            records, _ = store.load()
+            ids = sweep_ids(records)
+            if not ids:
+                return 200, {"ledger": self.ledger_dir, "sweeps": [],
+                             "error": "no matrix-sweep records"}
+            wanted = query.get("sweep", "")
+            sweep = ids[-1]
+            if wanted:
+                matches = [s for s in ids
+                           if s == wanted or s.startswith(wanted)]
+                if len(matches) != 1:
+                    return 404, {"error": f"no unique sweep {wanted!r}",
+                                 "sweeps": ids}
+                sweep = matches[0]
+            board = leaderboard(outcome_rows(records, sweep_id=sweep),
+                                sweep_id=sweep, n_boot=200)
+            return 200, {"ledger": self.ledger_dir, "sweeps": ids,
+                         **board}
+        except Exception as e:  # noqa: BLE001 — observational endpoint
+            return 200, {"ledger": self.ledger_dir,
+                         "error": f"{type(e).__name__}: {e}"[:300]}
 
     def _route_status(self, query, body):
         job_id = query.get("job", "")

@@ -152,6 +152,19 @@ Schema v12 (ISSUE 16) extends v11 — every v1-v11 file still validates:
   timeline (and the ledger's per-tenant accounting) without guessing.
   Type-checked when present; v1-v11 headers carry none of them.
 
+Schema v13 (ISSUE 17) extends v12 — every v1-v12 file still validates:
+
+* ``science`` — the scenario-science observatory's sweep-level summary
+  (:mod:`attackfl_tpu.science`): one record per finished matrix sweep
+  carrying the outcome join's distilled leaderboard (``sweep_id`` plus
+  optional typed fields: ``cells`` / ``attacks`` / ``defenses`` /
+  ``seeds`` counts, ``baseline`` — the clean-baseline attack-axis value
+  damage is measured against, ``leaderboard`` — the per-defense
+  robustness ranking rows, ``quality_key`` — the metric the scores
+  read).  Emitted at the matrix executor's ``_finish`` seam, fail-open
+  like the ledger append: a sweep whose science distillation raises is
+  still a finished sweep.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -168,7 +181,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -242,6 +255,21 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # job lands on a slot, one release (with the measured busy_seconds)
     # when it leaves, whatever the reason (done/failed/preempt/drain)
     "slot": {"slot": int, "action": str},
+    # --- schema v13 kind (ISSUE 17) ---
+    # scenario-science sweep summary (attackfl_tpu/science): the outcome
+    # join's distilled per-defense leaderboard for one finished matrix
+    # sweep.  Everything beyond the sweep identity is OPTIONAL (below) —
+    # a sweep too small to rank still leaves a record
+    "science": {"sweep_id": str},
+}
+
+# --- schema v13: optional leaderboard payload on `science` events ---
+# (type-checked when present; `leaderboard` rows are the rank.py
+# defense-score dicts, `baseline` names the clean-baseline attack-axis
+# value damage is measured against)
+_OPTIONAL_SCIENCE_FIELDS: dict[str, Any] = {
+    "cells": int, "attacks": int, "defenses": int, "seeds": int,
+    "baseline": str, "quality_key": str, "leaderboard": list,
 }
 
 # --- schema v12: optional occupancy payload on `slot` events ---
@@ -336,6 +364,8 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     # run_header sched_fleet_id/sched_slot/sched_tenant provenance, and
     # the optional occupancy payload on the new kind itself
     12: frozenset({"slot"}),
+    # + the optional leaderboard payload on the new kind itself
+    13: frozenset({"science"}),
 }
 
 
@@ -457,6 +487,13 @@ def validate_event(record: Any) -> list[str]:
                                        or not isinstance(record[name], typ)):
                     errors.append(
                         f"[slot] '{name}' has type "
+                        f"{type(record[name]).__name__}")
+        if kind == "science":
+            for name, typ in _OPTIONAL_SCIENCE_FIELDS.items():
+                if name in record and (isinstance(record[name], bool)
+                                       or not isinstance(record[name], typ)):
+                    errors.append(
+                        f"[science] '{name}' has type "
                         f"{type(record[name]).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:

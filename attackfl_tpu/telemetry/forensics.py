@@ -109,6 +109,41 @@ def forensics_summary(events: list[dict[str, Any]]) -> dict[str, Any] | None:
     }
 
 
+def forensics_by_defense(events: list[dict[str, Any]]
+                         ) -> dict[str, Any] | None:
+    """Cross-stream aggregate for a MERGED spool (ISSUE 17 satellite).
+
+    ``metrics --merge --forensics`` used to keep only the last run of
+    the merged stream; a service spool or a sweep's merged cell spools
+    carry MANY runs with different defenses.  This aggregates the whole
+    merged event list (the dedup key is already ``(run_id, round,
+    broadcast)``-aware, so SPMD duplicates still collapse while distinct
+    runs all count) and adds a per-defense breakdown grouped by each
+    attribution event's ``mode`` stamp.  Returns None when no stream
+    recorded attribution events.
+    """
+    overall = forensics_summary(events)
+    if overall is None:
+        return None
+    by_mode: dict[str, list[dict[str, Any]]] = {}
+    for event in events:
+        if event.get("kind") == "attribution":
+            by_mode.setdefault(str(event.get("mode")), []).append(event)
+    defenses: dict[str, dict[str, Any]] = {}
+    for mode, chunk in sorted(by_mode.items()):
+        summary = forensics_summary(chunk)
+        if summary is not None:
+            defenses[mode] = {k: summary.get(k) for k in
+                              ("rounds", "attack_rounds", "tp", "fp",
+                               "fn", "tn", "tpr", "fpr", "precision")}
+    if len(defenses) > 1:
+        overall["mode"] = "+".join(sorted(defenses))
+    overall["runs"] = len({e.get("run_id") for e in events
+                           if e.get("kind") == "attribution"})
+    overall["by_defense"] = defenses
+    return overall
+
+
 def format_forensics(summary: dict[str, Any],
                      run_id: str | None = None) -> str:
     def fmt(value: float | None) -> str:
@@ -128,6 +163,18 @@ def format_forensics(summary: dict[str, Any],
     if summary.get("rollbacks"):
         lines.append(f"rollbacks: {summary['rollbacks']} round(s) rolled "
                      "back by detection removals")
+    by_defense = summary.get("by_defense") or {}
+    if by_defense:
+        lines.append(
+            f"per-defense breakdown ({summary.get('runs', '?')} "
+            f"stream(s)):")
+        lines.append(f"  {'defense':<14}{'rounds':>7}{'attack':>7}"
+                     f"{'TPR':>8}{'FPR':>8}{'prec':>8}")
+        for mode, row in by_defense.items():
+            lines.append(
+                f"  {mode:<14}{row['rounds']:>7}{row['attack_rounds']:>7}"
+                f"{fmt(row['tpr']):>8}{fmt(row['fpr']):>8}"
+                f"{fmt(row['precision']):>8}")
     flagged = [r for r in summary["per_round"] if r["attackers"]]
     if flagged:
         lines.append(f"{'round':<8}{'attackers':>10}{'removed':>9}"

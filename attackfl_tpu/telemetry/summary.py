@@ -289,7 +289,7 @@ def _merge_main(args) -> int:
         print(f"no events*.jsonl under {args.path!r}", file=sys.stderr)
         return 2
     if args.forensics:
-        return _forensics_main(args, merged)
+        return _forensics_main(args, merged, merged_stream=True)
     if args.numerics:
         return _numerics_main(args, merged)
     if args.programs:
@@ -370,10 +370,24 @@ def _programs_main(args, events: list[dict[str, Any]]) -> int:
     return 0
 
 
-def _forensics_main(args, events: list[dict[str, Any]]) -> int:
+def _forensics_main(args, events: list[dict[str, Any]],
+                    merged_stream: bool = False) -> int:
     from attackfl_tpu.telemetry.forensics import (
-        forensics_summary, format_forensics,
+        forensics_by_defense, forensics_summary, format_forensics,
     )
+
+    if merged_stream and not args.run_id:
+        # a merged multi-stream spool (service spool, sweep cell spools)
+        # is ONE cross-run aggregate with a per-defense breakdown — the
+        # old keep-the-last-run rule silently dropped every other stream
+        summary = forensics_by_defense(events)
+        if summary is None:
+            print("no attribution events found in the merged stream",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(summary, indent=1) if args.json
+              else format_forensics(summary))
+        return 0
 
     runs = _select_runs(events, args.run_id, args.all)
     if not runs:

@@ -63,7 +63,7 @@ from attackfl_tpu.matrix.grid import (
     Cell, GridSpec, cell_config, defense_group, expand_cells,
 )
 from attackfl_tpu.matrix.program import build_cell_body, build_matrix_body
-from attackfl_tpu.matrix.records import sweep_records
+from attackfl_tpu.matrix.records import cell_event_summaries, sweep_records
 from attackfl_tpu.ops import metrics as num_metrics
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.registry import get_model
@@ -856,7 +856,8 @@ class MatrixRun:
                 t_start: float, interrupted: bool) -> None:
         tel = self.telemetry
         wall = time.perf_counter() - t_start
-        self._append_ledger_records(histories, wall)
+        records = self._distill_records(histories, wall)
+        self._append_ledger_records(records)
         if tel.enabled:
             tel.events.emit(
                 "matrix", sweep_id=self.sweep_id,
@@ -864,6 +865,7 @@ class MatrixRun:
                 cells_done=len(histories), seconds=round(wall, 6),
                 **({"stop_reason": self.stop_reason}
                    if interrupted and self.stop_reason else {}))
+            self._emit_science(records)
             tel.events.emit("counters", counters=tel.counters.snapshot())
             total = sum(len(h) for h in histories.values())
             tel.events.emit(
@@ -873,13 +875,36 @@ class MatrixRun:
                 seconds=round(wall, 6))
             tel.flush()
 
-    def _append_ledger_records(self,
-                               histories: dict[str, list[dict[str, Any]]],
-                               wall: float) -> None:
-        if self._ledger is None or not histories:
-            return
+    def _mine_cell_summaries(self) -> dict[str, dict[str, Any]]:
+        """Per-cell forensics/numerics blocks mined from the sweep's own
+        telemetry (ISSUE 17): batched cells' drainer events sit
+        cell-stamped in the sweep spool (``_CellTelemetry``); each
+        fallback cell ran against its OWN spool under ``cells/<key>/``,
+        whose events carry no stamp — assigned here at read time."""
+        from attackfl_tpu.telemetry.summary import load_events
+
+        events: list[dict[str, Any]] = []
+        spool = self.telemetry.events.path
+        if spool and os.path.exists(spool):
+            self.telemetry.events.flush()
+            events.extend(load_events(spool))
+        for cell in self.fallback_cells:
+            path = os.path.join(self._cell_dir(cell), "events.jsonl")
+            if not os.path.exists(path):
+                continue
+            for event in load_events(path):
+                event.setdefault("cell", cell.key)
+                events.append(event)
+        return cell_event_summaries(events)
+
+    def _distill_records(self, histories: dict[str, list[dict[str, Any]]],
+                         wall: float) -> list[dict[str, Any]]:
+        """The sweep's per-cell ledger records (also the science event's
+        input).  Fail-open: distillation is observability."""
+        if not histories:
+            return []
         try:
-            records = sweep_records(
+            return sweep_records(
                 sweep_id=self.sweep_id, cells=self.cells,
                 histories=histories, base_cfg=self.cfg,
                 rounds=self.grid.rounds,
@@ -890,7 +915,20 @@ class MatrixRun:
                             "mesh_devices": (self.mesh.size
                                              if self.mesh is not None
                                              else 0)},
-                programs=dict(self._program_profiles) or None)
+                programs=dict(self._program_profiles) or None,
+                event_summaries=self._mine_cell_summaries())
+        except Exception as e:  # noqa: BLE001 — observability, fail open
+            self.telemetry.counters.inc("ledger_append_failures")
+            print_with_color(
+                f"[matrix] record distillation failed (sweep "
+                f"unaffected): {type(e).__name__}: {e}", "yellow")
+            return []
+
+    def _append_ledger_records(self,
+                               records: list[dict[str, Any]]) -> None:
+        if self._ledger is None or not records:
+            return
+        try:
             for record in records:
                 self._ledger.append(record)
             self.telemetry.counters.inc("ledger_records_appended",
@@ -899,6 +937,44 @@ class MatrixRun:
             self.telemetry.counters.inc("ledger_append_failures")
             print_with_color(
                 f"[matrix] ledger append failed (sweep unaffected): "
+                f"{type(e).__name__}: {e}", "yellow")
+
+    def _emit_science(self, records: list[dict[str, Any]]) -> None:
+        """Sweep-level ``science`` event (schema v13): the defense
+        leaderboard the scoreboard CLI would compute, stamped into the
+        spool so the ranking travels with the sweep's artifacts (and the
+        service daemon's ``/science`` route can serve it).  Fail-open —
+        ranking must never fail the sweep."""
+        try:
+            from attackfl_tpu.science.outcomes import (
+                BASELINE_ATTACK, outcome_rows,
+            )
+            from attackfl_tpu.science.rank import leaderboard
+
+            rows = outcome_rows(records, sweep_id=self.sweep_id)
+            if not rows:
+                return
+            board = leaderboard(rows, sweep_id=self.sweep_id, n_boot=200)
+            fields: dict[str, Any] = {
+                "cells": board["cells"], "attacks": board["attacks"],
+                "defenses": board["defenses"], "seeds": board["seeds"],
+                "baseline": BASELINE_ATTACK,
+                "leaderboard": [
+                    {"defense": e["defense"], "rank": e["rank"],
+                     "damage_mean": e["damage_mean"],
+                     "damage_worst": e["damage_worst"],
+                     "quality_mean": e["quality_mean"],
+                     "seed_spread": e["seed_spread"]}
+                    for e in board["leaderboard"]],
+            }
+            if board.get("quality_key"):
+                fields["quality_key"] = board["quality_key"]
+            self.telemetry.events.emit(
+                "science", sweep_id=self.sweep_id, **fields)
+        except Exception as e:  # noqa: BLE001 — observability, fail open
+            self.telemetry.counters.inc("science_emit_failures")
+            print_with_color(
+                f"[matrix] science summary failed (sweep unaffected): "
                 f"{type(e).__name__}: {e}", "yellow")
 
     def close(self) -> None:

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from attackfl_tpu.config import Config
+from attackfl_tpu.config import NONE_ATTACK, Config
 from attackfl_tpu.data.partition import apply_client_dropout, sample_round_indices
 from attackfl_tpu.faults.inject import apply_nan_storm, build_client_fault_fn
 from attackfl_tpu.ops import aggregators, attacks
@@ -153,7 +153,8 @@ def active_attack_modes(groups: Sequence[AttackGroup], broadcast_number: int,
     if not have_genuine:
         return []
     return sorted({g.mode for g in groups
-                   if broadcast_number >= g.attack_round})
+                   if broadcast_number >= g.attack_round
+                   and g.mode != NONE_ATTACK})
 
 
 def active_attacker_indices(groups: Sequence[AttackGroup],
@@ -166,7 +167,7 @@ def active_attacker_indices(groups: Sequence[AttackGroup],
     if not have_genuine:
         return []
     return sorted({cid for g in groups if broadcast_number >= g.attack_round
-                   for cid in g.indices})
+                   and g.mode != NONE_ATTACK for cid in g.indices})
 
 
 def build_round_step(
@@ -293,6 +294,15 @@ def build_round_step(
         stacked = constrain(stacked)
 
         for gi, grp in enumerate(attack_groups):
+            if grp.mode == NONE_ATTACK:
+                # clean-baseline cohort (ISSUE 17): the group keeps its
+                # static geometry (excluded from the genuine leak pool
+                # above) but contributes ZERO ops — the compiled program
+                # is the benign program, so a `none` matrix cell is
+                # bit-identical to a standalone run of the same config.
+                # Skipping BEFORE the per-group key fold keeps the other
+                # groups' keys untouched (each folds its own gi).
+                continue
             n_attackers = len(grp.indices)
             keys = jax.random.split(jax.random.fold_in(k_attack, gi), n_attackers)
             active = (broadcast_number >= grp.attack_round) & have_genuine

@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# One-shot scenario-science smoke gate (ISSUE 17 tentpole), the sibling
+# of scripts/fleet_smoke.sh: runs a REAL tiny matrix sweep that includes
+# the `none` clean-baseline attack cohort, then asserts the observatory
+# closes end to end — the sweep spool carries a schema-v13 `science`
+# event, `science leaderboard` ranks the defenses with measured damage,
+# `science report` writes a scoreboard whose outcome rows all join a
+# baseline, diff-vs-self passes the rank gate (exit 0), and a synthetic
+# ranking flip fails it (exit 1) with a reported noise floor.  Used by
+# tier-1 through tests/test_science.py; run it directly before a PR.
+#
+# Usage: scripts/science_smoke.sh [work-dir]   (default: a fresh tmp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the pytest session routes telemetry to its own tmp dir (conftest);
+# this smoke asserts on the sweep's OWN spool path, so undo that here
+unset ATTACKFL_TELEMETRY_DIR
+# share the persistent compile cache so repeat smokes skip the compile
+export ATTACKFL_COMPILE_CACHE="${ATTACKFL_COMPILE_CACHE:-/tmp/attackfl_jax_cache}"
+
+WORK="${1:-$(mktemp -d /tmp/attackfl_science_smoke.XXXXXX)}"
+mkdir -p "$WORK"
+export ATTACKFL_LEDGER_DIR="$WORK/ledger"
+CFG="$WORK/config.yaml"
+cat > "$CFG" <<'YAML'
+server:
+  num-round: 2
+  clients: 4
+  mode: fedavg
+  model: CNNModel
+  data-name: ICU
+  validation: true
+  train-size: 256
+  test-size: 128
+  random-seed: 1
+  data-distribution:
+    num-data-range: [48, 64]
+learning:
+  epoch: 1
+  batch-size: 32
+matrix:
+  attacks: ["none", "LIE"]
+  attack-clients: 1
+  defenses: ["fedavg", "median"]
+  seeds: [1, 2]
+  rounds: 2
+  chunk: 2
+YAML
+
+echo "--- real sweep: (none + LIE) x (fedavg, median) x 2 seeds"
+python -m attackfl_tpu matrix run --config "$CFG" \
+    --sweep-dir "$WORK/sweep" --sweep-id smoke-sci
+
+echo "--- sweep spool carries the schema-v13 science event"
+python scripts/check_event_schema.py "$WORK/sweep/events.jsonl"
+grep -q '"kind": "science"' "$WORK/sweep/events.jsonl" \
+    || { echo "no science event in the sweep spool" >&2; exit 1; }
+
+echo "--- leaderboard + scoreboard from the sweep's ledger records"
+python -m attackfl_tpu science leaderboard --sweep-id smoke-sci
+python -m attackfl_tpu science report --sweep-id smoke-sci \
+    --out "$WORK/SCOREBOARD.json"
+python - "$WORK/SCOREBOARD.json" <<'PY'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["has_baseline"], "the none cohort produced no baseline cells"
+assert doc["defenses"] == 2 and doc["seeds"] == 2, doc
+attacked = [r for r in doc["outcomes"] if r["attack"] != "none"]
+assert attacked and all(r["damage"] is not None for r in attacked), \
+    "an attacked cell failed to join its clean baseline"
+assert all(e["damage_mean"] is not None for e in doc["leaderboard"])
+print(f"scoreboard: {len(doc['outcomes'])} outcome rows, every attacked "
+      "cell joined a baseline")
+PY
+
+echo "--- rank gate: diff-vs-self must pass"
+python -m attackfl_tpu science diff smoke-sci smoke-sci --gate
+
+echo "--- rank gate: a synthetic ranking flip must fail"
+python - "$ATTACKFL_LEDGER_DIR/ledger.jsonl" <<'PY'
+import json
+import sys
+
+# clone the sweep as `smoke-flip`, collapsing the rank-1 defense: its
+# attacked cells lose 0.3 quality, far past any inter-seed noise floor
+path = sys.argv[1]
+records = [json.loads(line) for line in open(path)]
+cells = [r for r in records if r.get("sweep_id") == "smoke-sci"]
+from attackfl_tpu.science.outcomes import outcome_rows
+from attackfl_tpu.science.rank import defense_scores
+
+best = defense_scores(outcome_rows(cells))[0]["defense"]
+with open(path, "a") as fh:
+    for r in cells:
+        clone = json.loads(json.dumps(r))
+        clone["sweep_id"] = "smoke-flip"
+        clone["record_id"] = "flip-" + clone["record_id"]
+        detail = clone.get("cell_detail") or {}
+        if detail.get("defense") == best and detail.get("attack") != "none":
+            for key, value in (clone.get("final") or {}).items():
+                if key in ("roc_auc", "accuracy"):
+                    clone["final"][key] = round(value - 0.3, 6)
+        fh.write(json.dumps(clone) + "\n")
+print(f"flip sweep appended: defense {best!r} collapses")
+PY
+if python -m attackfl_tpu science diff smoke-sci smoke-flip --gate \
+    > "$WORK/flip.out" 2>&1; then
+    echo "rank gate passed a ranking flip" >&2
+    cat "$WORK/flip.out" >&2
+    exit 1
+fi
+cat "$WORK/flip.out"
+grep -q "noise floor" "$WORK/flip.out" \
+    || { echo "gate verdict reports no noise floor" >&2; exit 1; }
+
+echo "--- ledger rollup + regress hook"
+python -m attackfl_tpu ledger list --sweep smoke-sci
+python -m attackfl_tpu ledger regress --sweeps smoke-sci smoke-sci
+echo "science smoke: OK"

@@ -33,6 +33,7 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/events.v10.jsonl" in names
     assert "tests/data/events.v11.jsonl" in names
     assert "tests/data/events.v12.jsonl" in names
+    assert "tests/data/events.v13.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 
@@ -154,3 +155,30 @@ def test_v12_fleet_artifact_validates_standalone():
     fleet_ids = {e["fleet_id"] for e in dispatch}
     assert all(e["sched_fleet_id"] in fleet_ids for e in headers)
     assert all(isinstance(e["sched_slot"], int) for e in headers)
+
+
+def test_v13_science_artifact_validates_standalone():
+    """The committed v13 corpus (ISSUE 17, from a real 18-cell matrix
+    sweep): the sweep spool's `science` event validates and carries the
+    defense leaderboard the observatory distilled — ranks sequential,
+    damage measured against the sweep's own `none` baseline cohort."""
+    import json
+
+    lint = load_lint()
+    path = REPO / "tests" / "data" / "events.v13.jsonl"
+    assert lint.check_file(path) == []
+    events = [json.loads(line) for line in path.open()]
+    science = [e for e in events if e["kind"] == "science"]
+    assert len(science) == 1, "one science event per sweep spool"
+    event = science[0]
+    assert event["schema"] == 13
+    assert event["sweep_id"] and event["baseline"] == "none"
+    assert event["cells"] == event["defenses"] * (event["attacks"] + 1) \
+        * event["seeds"]
+    board = event["leaderboard"]
+    assert [entry["rank"] for entry in board] == \
+        list(range(1, len(board) + 1))
+    assert all(isinstance(entry["damage_mean"], float) for entry in board)
+    # damage ranks ascending: rank 1 is the most robust defense
+    damages = [entry["damage_mean"] for entry in board]
+    assert damages == sorted(damages)

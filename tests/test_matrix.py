@@ -160,6 +160,37 @@ def test_matrix_parity_bit_identical(tmp_path, capsys):
         assert len(histories[cell.key]) == len(hist)
 
 
+def test_none_cell_bit_identical_to_benign_run(tmp_path):
+    """The `none` clean-baseline attack (ISSUE 17 satellite) must be a
+    TRUE control: a none cell keeps the attacked cells' cohort geometry
+    (the attacker clients exist, their updates are their genuine
+    training) yet its final params are bit-identical to BOTH a
+    standalone run of its own cell config AND a fully benign run with
+    no attacks configured at all — round_step skips the none group
+    before any per-group key fold, so the compiled program never
+    diverges from the benign one."""
+    base = _base(tmp_path / "m")
+    grid = _grid(attacks=(AttackSpec(mode="none", num_clients=1,
+                                     attack_round=2),),
+                 defenses=("fedavg",), seeds=(1,), chunk=3)
+    runner = MatrixRun(base, grid)
+    final, histories = runner.run(verbose=False, save_checkpoints=False)
+    runner.close()
+    for i, cell in enumerate(expand_cells(grid)):
+        ccfg = cell_config(_base(tmp_path / f"s{i}"), cell, rounds=3)
+        state, hist = Simulator(ccfg).run(
+            num_rounds=3, save_checkpoints=False, verbose=False)
+        assert _leaves_equal(final[cell.key], state["global_params"]), \
+            f"none cell {cell.key} diverged from its standalone run"
+        benign = Simulator(ccfg.replace(attacks=()))
+        bstate, _ = benign.run(num_rounds=3, save_checkpoints=False,
+                               verbose=False)
+        assert _leaves_equal(state["global_params"],
+                             bstate["global_params"]), \
+            f"none cell {cell.key} is not bit-identical to a benign run"
+        assert len(histories[cell.key]) == len(hist) == 3
+
+
 # ---------------------------------------------------------------------------
 # chaos: die mid-sweep, resume, byte-identical grid
 # ---------------------------------------------------------------------------

@@ -1,0 +1,375 @@
+"""Scenario science observatory (ISSUE 17): the outcome join, the
+leaderboard + bootstrap rank statistics, the rank-regression gate, the
+`science` CLI, the ledger rollup/regress hooks, merged-stream forensics,
+and the one-shot smoke gate.
+
+Golden values come from the committed corpus
+``tests/data/science_corpus/ledger.jsonl``: three synthetic sweeps over
+(none + LIE + Min-Max) x (krum, median, trimmed_mean) x seeds 1-3.
+``base-a`` and ``base-b`` share the true per-defense damage (krum 0.015
+< median 0.05 < trimmed_mean 0.09) with a +/-0.004 per-seed wobble (the
+measured noise floor); ``flip`` collapses krum so its rank genuinely
+flips past that floor.  Everything here is jax-free except the smoke
+subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+from attackfl_tpu.ledger.cli import main as ledger_main, sweep_rollup
+from attackfl_tpu.science.cli import build_report, main as science_main
+from attackfl_tpu.science.outcomes import (
+    outcome_rows, parse_cell_key, pick_quality_key, sweep_ids,
+)
+from attackfl_tpu.science.rank import (
+    bootstrap_ci, defense_scores, kendall_tau, leaderboard, rank_diff,
+    seed_spread,
+)
+from attackfl_tpu.telemetry.forensics import forensics_by_defense
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "tests" / "data" / "science_corpus"
+
+
+def _records():
+    return [json.loads(line)
+            for line in (CORPUS / "ledger.jsonl").open()]
+
+
+def _board(records, sweep):
+    return leaderboard(outcome_rows(records, sweep_id=sweep),
+                       sweep_id=sweep)
+
+
+# ---------------------------------------------------------------------------
+# the outcome join
+# ---------------------------------------------------------------------------
+
+def test_parse_cell_key_handles_modes_containing_x():
+    # "Min-Max" ends in characters that make a naive first-x split wrong
+    assert parse_cell_key("Min-Maxxkrum.s3") == ("Min-Max", "krum", 3)
+    assert parse_cell_key("nonexfedavg.s1") == ("none", "fedavg", 1)
+    assert parse_cell_key("LIExtrimmed_mean.s12") == \
+        ("LIE", "trimmed_mean", 12)
+    assert parse_cell_key("garbage") is None
+    assert parse_cell_key("LIExmedian") is None  # no seed suffix
+    assert parse_cell_key("LIExmedian.sNaN") is None
+
+
+def test_outcome_join_golden_damage():
+    rows = outcome_rows(_records(), sweep_id="base-a")
+    assert len(rows) == 27
+    assert pick_quality_key(_records()) == "roc_auc"
+    assert all(r["quality_key"] == "roc_auc" for r in rows)
+    # every `none` row: damage is identically 0 (it IS the baseline)
+    none_rows = [r for r in rows if r["attack"] == "none"]
+    assert len(none_rows) == 9
+    assert {r["damage"] for r in none_rows} == {0.0}
+    # paired damage: baseline is the none cell of the SAME defense+seed,
+    # so the +/-0.004 seed wobble survives into per-seed damage
+    krum_lie = {r["seed"]: r for r in rows
+                if r["defense"] == "krum" and r["attack"] == "LIE"}
+    assert krum_lie[1]["damage"] == 0.016
+    assert krum_lie[2]["damage"] == 0.02
+    assert krum_lie[3]["damage"] == 0.024
+    assert krum_lie[2]["baseline_quality"] == 0.954
+    # forensics columns rode along from the records
+    assert krum_lie[2]["tpr"] == 0.8 and krum_lie[2]["fpr"] == 0.05
+
+
+def test_outcome_join_without_baseline_never_fabricates_zero():
+    records = [r for r in _records()
+               if (r.get("cell_detail") or {}).get("attack") != "none"]
+    rows = outcome_rows(records, sweep_id="base-a")
+    assert rows and all(r["damage"] is None for r in rows)
+    board = leaderboard(rows, sweep_id="base-a")
+    assert board["has_baseline"] is False
+    # the sweep still ranks, on raw quality, and says so
+    entries = board["leaderboard"]
+    assert all(e["ranked_by"] == "quality" for e in entries)
+    assert [e["defense"] for e in entries] == \
+        ["krum", "median", "trimmed_mean"]
+    assert all(e["damage_mean"] is None for e in entries)
+
+
+def test_outcome_join_falls_back_to_per_defense_baseline_mean():
+    # drop krum's seed-2 none cell: its attacked seed-2 rows must fall
+    # back to the mean of the surviving krum baselines, not to None
+    records = [r for r in _records()
+               if not (r.get("sweep_id") == "base-a"
+                       and r.get("cell") == "nonexkrum.s2")]
+    rows = outcome_rows(records, sweep_id="base-a")
+    row = next(r for r in rows if r["cell"] == "LIExkrum.s2")
+    assert row["baseline_quality"] == round((0.952 + 0.956) / 2, 6)
+    assert row["damage"] is not None
+
+
+def test_sweep_ids_order_and_dedup():
+    assert sweep_ids(_records()) == ["base-a", "base-b", "flip"]
+
+
+# ---------------------------------------------------------------------------
+# rank statistics
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_ci_is_deterministic_and_bracketing():
+    means = {1: 0.1, 2: 0.2, 3: 0.3}
+    first = bootstrap_ci(means, n_boot=200, boot_seed=7)
+    assert first == bootstrap_ci(means, n_boot=200, boot_seed=7)
+    lo, hi = first
+    assert 0.1 <= lo <= 0.2 <= hi <= 0.3
+    # a single seed carries no spread evidence: zero-width interval
+    assert bootstrap_ci({5: 0.42}) == (0.42, 0.42)
+    assert bootstrap_ci({}) is None
+
+
+def test_seed_spread_rules():
+    assert seed_spread({}) == 0.0
+    assert seed_spread({1: 0.5}) == 0.0
+    assert seed_spread({1: 0.0, 2: 0.2}) == 0.1
+
+
+def test_kendall_tau_edges():
+    a = {"krum": 1.0, "median": 2.0, "trimmed_mean": 3.0}
+    assert kendall_tau(a, dict(a)) == 1.0
+    reversed_b = {"krum": 3.0, "median": 2.0, "trimmed_mean": 1.0}
+    assert kendall_tau(a, reversed_b) == -1.0
+    # fewer than two common keys, or an all-ties side: no correlation
+    assert kendall_tau(a, {"krum": 1.0}) is None
+    assert kendall_tau(a, {"x": 1.0, "y": 2.0}) is None
+    assert kendall_tau(a, {k: 0.0 for k in a}) is None
+    # tau-b handles partial ties: one tied pair on one side
+    tied = {"krum": 1.0, "median": 1.0, "trimmed_mean": 2.0}
+    assert kendall_tau(a, tied) == 0.816497
+
+
+def test_golden_leaderboard_from_corpus():
+    rows = outcome_rows(_records(), sweep_id="base-a")
+    entries = defense_scores(rows)  # default n_boot/boot_seed: pinned
+    assert [e["defense"] for e in entries] == \
+        ["krum", "median", "trimmed_mean"]
+    assert [e["rank"] for e in entries] == [1, 2, 3]
+    assert [e["damage_mean"] for e in entries] == [0.015, 0.05, 0.09]
+    assert [e["seed_spread"] for e in entries] == [0.003266] * 3
+    assert entries[0]["damage_ci95"] == (0.011, 0.017667)
+    assert entries[0]["worst_attack"] == "LIE"
+    assert entries[0]["damage_worst"] == 0.02
+    # trimmed_mean's weaker detector shows in the forensics column
+    assert entries[0]["tpr_mean"] == 0.8
+    assert entries[2]["tpr_mean"] == 0.5
+    board = _board(_records(), "base-a")
+    assert (board["cells"], board["attacks"], board["defenses"],
+            board["seeds"]) == (27, 2, 3, 3)
+    assert board["has_baseline"] is True
+    attacks = board["attack_effectiveness"]
+    assert attacks[0]["attack"] == "LIE"  # the more damaging attack
+    assert attacks[0]["most_damaged_defense"] == "trimmed_mean"
+
+
+def test_rank_diff_identical_pair_is_stable():
+    board = _board(_records(), "base-a")
+    diff = rank_diff(board, json.loads(json.dumps(board)))
+    assert diff["ok"] is True and diff["violations"] == []
+    assert diff["kendall_tau"] == 1.0
+    assert all(e["damage_delta"] == 0.0 for e in diff["per_defense"])
+    # the noise floor is the measured inter-seed wobble, reported even
+    # when nothing fired
+    assert all(e["noise_floor"] == 0.003266 for e in diff["per_defense"])
+
+
+def test_rank_diff_seed_rerun_stays_under_noise_floor():
+    records = _records()
+    diff = rank_diff(_board(records, "base-a"), _board(records, "base-b"))
+    assert diff["ok"] is True, diff["violations"]
+    assert diff["kendall_tau"] == 1.0
+
+
+def test_rank_diff_catches_genuine_flip():
+    records = _records()
+    diff = rank_diff(_board(records, "base-a"), _board(records, "flip"))
+    assert diff["ok"] is False
+    kinds = {v["defense"]: v["violation"] for v in diff["violations"]}
+    assert kinds["krum"] == "rank_flip"
+    krum = next(e for e in diff["per_defense"] if e["defense"] == "krum")
+    assert krum["rank_old"] == 1 and krum["rank_new"] == 3
+    assert krum["damage_delta"] > krum["noise_floor"] > 0
+    assert diff["kendall_tau"] == -0.333333
+
+
+def test_rank_diff_damage_regression_without_flip():
+    # every defense degrading in lockstep flips no ranks but must still
+    # fail the gate
+    board = _board(_records(), "base-a")
+    worse = json.loads(json.dumps(board))
+    for entry in worse["leaderboard"]:
+        entry["damage_mean"] = round(entry["damage_mean"] + 0.05, 6)
+    diff = rank_diff(board, worse)
+    assert diff["ok"] is False
+    assert {v["violation"] for v in diff["violations"]} == \
+        {"damage_regression"}
+    assert len(diff["violations"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# the science CLI + gate exit codes
+# ---------------------------------------------------------------------------
+
+def test_science_gate_exit_codes(capsys):
+    corpus = ["--dir", str(CORPUS)]
+    assert science_main(
+        ["diff", "base-a", "base-b", "--gate"] + corpus) == 0
+    assert science_main(["diff", "base-a", "flip", "--gate"] + corpus) == 1
+    out = capsys.readouterr().out
+    assert "RANK REGRESSION" in out and "noise floor" in out
+    assert "FAIL rank_flip" in out
+    # without --gate the diff reports but never fails the build
+    assert science_main(["diff", "base-a", "flip"] + corpus) == 0
+    # nothing to compare -> 2, the "not measurable" convention
+    assert science_main(
+        ["diff", "base-a", "nosuch", "--gate"] + corpus) == 2
+
+
+def test_science_cli_empty_ledger_exits_2(tmp_path, capsys):
+    assert science_main(["leaderboard", "--dir", str(tmp_path)]) == 2
+    assert science_main(["diff", "--gate", "--dir", str(tmp_path)]) == 2
+
+
+def test_science_cli_prefix_resolution_and_outcomes(capsys):
+    corpus = ["--dir", str(CORPUS)]
+    # "base-" is ambiguous (base-a, base-b); "fl" resolves to flip
+    assert science_main(
+        ["leaderboard", "--sweep-id", "base-", "--json"] + corpus) == 2
+    capsys.readouterr()
+    assert science_main(
+        ["leaderboard", "--sweep-id", "fl", "--json"] + corpus) == 0
+    board = json.loads(capsys.readouterr().out)
+    assert board["sweep_id"] == "flip"
+    assert science_main(
+        ["leaderboard", "--sweep-id", "base-a", "--outcomes"]
+        + corpus) == 0
+    out = capsys.readouterr().out
+    assert "Min-Maxxkrum.s1" in out and "damage" in out
+
+
+def test_science_report_document(tmp_path, capsys):
+    out_path = tmp_path / "SCOREBOARD.json"
+    assert science_main(
+        ["report", "--sweep-id", "base-a", "--dir", str(CORPUS),
+         "--out", str(out_path)]) == 0
+    doc = json.loads(out_path.read_text())
+    assert doc["scoreboard_version"] == 1
+    assert doc["bootstrap"] == {"n": 1000, "seed": 0}
+    assert len(doc["outcomes"]) == 27
+    assert [e["defense"] for e in doc["leaderboard"]] == \
+        ["krum", "median", "trimmed_mean"]
+
+
+def test_committed_scoreboard_is_self_consistent():
+    """SCOREBOARD.json (from a real sweep on this box) must stay
+    derivable from its own committed outcome rows — the ranking is
+    auditable without the ledger that produced it."""
+    doc = json.loads((REPO / "SCOREBOARD.json").read_text())
+    assert doc["scoreboard_version"] == 1
+    assert doc["has_baseline"] is True
+    attacked = [r for r in doc["outcomes"] if r["attack"] != "none"]
+    assert attacked and all(r["damage"] is not None for r in attacked)
+    rebuilt = defense_scores(doc["outcomes"],
+                             n_boot=doc["bootstrap"]["n"],
+                             boot_seed=doc["bootstrap"]["seed"])
+    committed = doc["leaderboard"]
+    assert [e["defense"] for e in rebuilt] == \
+        [e["defense"] for e in committed]
+    for new, old in zip(rebuilt, committed):
+        assert new["damage_mean"] == old["damage_mean"]
+        assert new["rank"] == old["rank"]
+        assert list(new["damage_ci95"]) == list(old["damage_ci95"])
+
+
+# ---------------------------------------------------------------------------
+# ledger hooks: list --sweep rollup, regress --sweeps delegation
+# ---------------------------------------------------------------------------
+
+def test_ledger_sweep_rollup_line():
+    line = sweep_rollup(_records(), "base-a")
+    assert "27 cell(s), 27 complete, 0 quarantined/cut" in line
+    assert "median roc_auc" in line
+    assert sweep_rollup([], "ghost") == "sweep ghost: no cell records"
+
+
+def test_ledger_list_sweep_filter_and_rollup(capsys):
+    assert ledger_main(
+        ["list", "--sweep", "base-a", "--dir", str(CORPUS)]) == 0
+    out = capsys.readouterr().out
+    assert "sweep base-a: 27 cell(s)" in out
+    assert "flip-" not in out  # other sweeps filtered out
+
+
+def test_ledger_regress_sweeps_delegates_to_gate(capsys):
+    corpus = ["--dir", str(CORPUS)]
+    assert ledger_main(
+        ["regress", "--sweeps", "base-a", "base-b"] + corpus) == 0
+    assert ledger_main(
+        ["regress", "--sweeps", "base-a", "flip"] + corpus) == 1
+    assert "RANK REGRESSION" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# merged-stream forensics (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+def _attr(run_id, rnd, mode, attackers, kept, removed, broadcast=None):
+    return {"kind": "attribution", "run_id": run_id, "round": rnd,
+            "broadcast": broadcast if broadcast is not None else rnd,
+            "mode": mode, "attackers": attackers, "kept": kept,
+            "removed": removed}
+
+
+def test_forensics_by_defense_aggregates_whole_merged_stream():
+    events = [
+        # run A (krum): perfect detection, duplicated SPMD-style — the
+        # same broadcast from two processes must count once
+        _attr("run-a", 1, "krum", [3], [0, 1, 2], [3]),
+        _attr("run-a", 1, "krum", [3], [0, 1, 2], [3]),
+        _attr("run-a", 2, "krum", [3], [0, 1, 2], [3]),
+        # run B (median): misses the attacker, removes an honest client
+        _attr("run-b", 1, "median", [3], [1, 2, 3], [0]),
+    ]
+    summary = forensics_by_defense(events)
+    assert summary is not None
+    assert summary["runs"] == 2
+    assert summary["mode"] == "krum+median"
+    # whole-stream micro totals: 2 tp (krum) + 0 tp (median)
+    assert summary["tp"] == 2 and summary["fp"] == 1
+    assert summary["rounds"] == 3
+    by_defense = summary["by_defense"]
+    assert set(by_defense) == {"krum", "median"}
+    assert by_defense["krum"]["tpr"] == 1.0
+    assert by_defense["krum"]["rounds"] == 2  # dedup collapsed the dup
+    assert by_defense["median"]["tpr"] == 0.0
+    assert by_defense["median"]["fpr"] == round(1 / 3, 6)
+    assert forensics_by_defense([{"kind": "round"}]) is None
+
+
+# ---------------------------------------------------------------------------
+# the one-shot smoke gate: a REAL sweep through the whole observatory
+# ---------------------------------------------------------------------------
+
+def test_science_smoke_script():
+    """scripts/science_smoke.sh — real (none+LIE) x (fedavg, median) x
+    2-seed sweep, then: schema-v13 science event in the spool, every
+    attacked cell joins its clean baseline, diff-vs-self passes the
+    gate, a synthetic rank flip fails it with a reported noise floor,
+    and the ledger rollup/regress hooks close."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    result = subprocess.run(
+        ["bash", str(REPO / "scripts" / "science_smoke.sh")],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=560)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "science smoke: OK" in result.stdout
+    assert "every attacked cell joined a baseline" in result.stdout
