@@ -82,6 +82,12 @@ COSTMODEL_DIR = REPO / "attackfl_tpu" / "costmodel"
 # placement plumbing and stays outside this lint, like the engine's
 # non-hot-path modules)
 PARALLEL_FILES = (REPO / "attackfl_tpu" / "parallel" / "shard.py",)
+# the hotspot observatory (ISSUE 19): the capture half wraps
+# jax.profiler start/stop around dispatch seams (never a sync), and the
+# mining/CLI halves are stdlib-only JSON arithmetic — NO allowlist by
+# design; numeric coercion in profiler/ uses the costmodel's `+ 0.0`
+# idiom, never float()
+PROFILER_DIR = REPO / "attackfl_tpu" / "profiler"
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -265,7 +271,8 @@ def host_sync_files() -> list[Path]:
             + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py"))
             + sorted(MATRIX_DIR.glob("*.py"))
             + sorted(COSTMODEL_DIR.glob("*.py"))
-            + list(PARALLEL_FILES))
+            + list(PARALLEL_FILES)
+            + sorted(PROFILER_DIR.glob("*.py")))
 
 
 @register(

@@ -198,6 +198,14 @@ def server_main(argv=None) -> None:
                         metavar="A:B",
                         help="wrap rounds A..B in jax.profiler device "
                              "tracing (output: <telemetry dir>/profile)")
+    parser.add_argument("--hotspots", type=str, default=None,
+                        metavar="A:B",
+                        help="hotspot observatory window (supersedes "
+                             "--profile-rounds): profile rounds A..B at "
+                             "the dispatch seam and mine the trace into "
+                             "a schema-v14 `hotspot` event (op-level "
+                             "attribution + dispatch-gap diagnosis; "
+                             "render with `attackfl-tpu hotspots show`)")
     parser.add_argument("--numerics", action="store_true",
                         help="in-graph numerics engine: device-side "
                              "per-round metric rows (update-norm "
@@ -247,6 +255,8 @@ def server_main(argv=None) -> None:
         overrides["monitor_port"] = args.monitor_port
     if args.profile_rounds is not None:
         overrides["profile_rounds"] = args.profile_rounds
+    if args.hotspots is not None:
+        overrides["hotspots"] = args.hotspots
     if args.numerics:
         overrides["numerics"] = True
     if overrides:
@@ -546,6 +556,14 @@ def watch_main(argv=None) -> int:
         except Exception:  # noqa: BLE001 — optional endpoint
             cost = {}
         utilization = cost.get("utilization") or {}
+        # hotspot observatory (ISSUE 19): latest mined window from
+        # /hotspots — hostbound= on the round line makes a dispatch-
+        # bound drift visible live
+        try:
+            _, hot = _http_get_json(base + "/hotspots")
+        except Exception:  # noqa: BLE001 — optional endpoint
+            hot = {}
+        hot_windows = hot.get("windows") or {}
         if code == 503:
             if not stalled:
                 print_with_color(f"[watch] STALL detected: {health}", "red")
@@ -607,6 +625,12 @@ def watch_main(argv=None) -> int:
             elif isinstance(achieved, (int, float)):
                 # no peak spec for this device kind (CPU): achieved-only
                 msg += f" flops/s={achieved:.3g}"
+            hostbound = [w.get("host_bound_fraction")
+                         for w in hot_windows.values()
+                         if isinstance(w.get("host_bound_fraction"),
+                                       (int, float))]
+            if hostbound:
+                msg += f" hostbound={max(hostbound):.3f}"
             print(f"[watch] round {rnd} ok={last.get('ok')} "
                   f"{msg}".rstrip(), flush=True)
         if args.once:
@@ -702,6 +726,15 @@ def ledger_main(argv=None) -> int:
     return _ledger_main(list(sys.argv[1:] if argv is None else argv))
 
 
+def hotspots_main(argv=None) -> int:
+    """``attackfl-tpu hotspots``: mine profiler traces into op-level
+    device-time attribution (show) or gate drift between two profile
+    dirs (diff).  Jax-free, like ``metrics`` and ``ledger``."""
+    from attackfl_tpu.profiler.cli import main as _hotspots_main
+
+    return _hotspots_main(list(sys.argv[1:] if argv is None else argv))
+
+
 _SUBCOMMANDS = {
     "run": run_main,
     "server": server_main,
@@ -716,6 +749,7 @@ _SUBCOMMANDS = {
     "job": job_main,
     "fleet": fleet_main,
     "science": science_main,
+    "hotspots": hotspots_main,
 }
 
 _USAGE = """usage: attackfl-tpu <command> [args]
@@ -753,6 +787,10 @@ commands:
            bootstrap CIs); report = auditable SCOREBOARD.json; diff
            --gate = rank-stability CI hook (exit 1 past the inter-seed
            noise floor)
+  hotspots profiler-trace mining (jax-free): show = per-op device-time
+           attribution + dispatch-gap diagnosis for a profile dir
+           (books-close gated); diff = host-bound-fraction / top-op
+           share drift gate between two profile dirs (exit 1 on drift)
 """
 
 

@@ -142,6 +142,13 @@ class TelemetryConfig:
     stall_factor: float = 10.0
     stall_grace_seconds: float = 900.0
     profile_rounds: str = ""
+    # hotspot observatory (ISSUE 19): the structured profiling window
+    # the capture half of attackfl_tpu/profiler drives — same 'A:B'
+    # format as profile_rounds (which it supersedes when both are set).
+    # Each window closes with a schema-v14 `hotspot` event carrying the
+    # mined op-level attribution; fail-open when the profiler backend
+    # is unavailable.
+    hotspots: str = ""
     numerics: bool = False
     numerics_window: int = 16
     ledger: bool = True
@@ -172,6 +179,7 @@ class TelemetryConfig:
                 f"telemetry.stall_grace_seconds must be > 0, got "
                 f"{self.stall_grace_seconds}")
         parse_profile_rounds(self.profile_rounds)  # validate format
+        parse_profile_rounds(self.hotspots)  # same 'A:B' grammar
         if not 2 <= self.numerics_window <= 65536:
             raise ValueError(
                 "telemetry.numerics_window must be in [2, 65536] (ring rows "
@@ -777,6 +785,7 @@ def config_from_dict(raw: dict) -> Config:
             stall_grace_seconds=float(
                 _get(tele, "stall-grace-seconds", 900.0)),
             profile_rounds=str(_get(tele, "profile-rounds", "")),
+            hotspots=str(_get(tele, "hotspots", "")),
             numerics=bool(_get(tele, "numerics", False)),
             numerics_window=int(_get(tele, "numerics-window", 16)),
             ledger=bool(_get(tele, "ledger", True)),

@@ -189,6 +189,21 @@ def format_record(record: dict[str, Any]) -> str:
             line += (f" (achieved-only: no peak spec for "
                      f"{utilization.get('device_kind') or 'this device'})")
         lines.append(line)
+    hotspots = record.get("hotspots") or {}
+    if hotspots:
+        line = (f"  hotspots: windows={_fmt(hotspots.get('windows'))} "
+                f"hostbound={_fmt(hotspots.get('host_bound_fraction'))} "
+                f"({hotspots.get('classification') or '-'}) "
+                f"books={'close' if hotspots.get('books_close') else 'OPEN'}")
+        factor = hotspots.get("hotspot_prediction_error_factor")
+        if factor is not None:
+            line += f" pred-err={_fmt(factor)}x"
+        lines.append(line)
+        top = hotspots.get("top_ops") or []
+        if top:
+            lines.append("  top ops: " + " ".join(
+                f"{row.get('name')}={_fmt(row.get('share'))}"
+                for row in top[:5] if isinstance(row, dict)))
     programs = record.get("programs") or {}
     if programs:
         lines.append(
@@ -282,6 +297,16 @@ def format_compare(diff: dict[str, Any]) -> str:
         render("sched", {"wait_seconds": sched.get("wait_seconds"),
                          "preemptions": sched.get("preemptions")},
                pct=False)
+    hotspots = diff.get("hotspots") or {}
+    if hotspots:
+        render("hotspots", {
+            "host_bound_fraction": hotspots.get("host_bound_fraction"),
+            "measured_device_s": hotspots.get("measured_round_device_s"),
+            "pred_error_factor": hotspots.get("prediction_error_factor"),
+        }, pct=False)
+        share_rows = {f"share:{name}": delta for name, delta in
+                      (hotspots.get("top_op_shares") or {}).items()}
+        render("top-op shares", share_rows, pct=False)
     counts = {k: v for k, v in (diff.get("counts") or {}).items()
               if isinstance(v, dict) and v.get("delta")}
     render("counts (changed)", counts, pct=False)

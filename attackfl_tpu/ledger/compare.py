@@ -50,6 +50,15 @@ DEFAULT_THRESHOLDS: dict[str, float] = {
     # box (pure dispatch jitter) is never declared a regression
     "queue_wait_pct": 100.0,
     "queue_wait_floor_s": 5.0,
+    # hotspot observatory (ISSUE 19): absolute host-bound-fraction rise
+    # past the peers' median that fails the gate, noise-floored by the
+    # peers' own observed spread (capped below — a wobbling baseline
+    # can't demand the moon), and absolute top-op self-time share drift
+    # (either direction: a kernel silently taking over the round and a
+    # kernel silently vanishing are both news)
+    "hostbound_rise": 0.15,
+    "hostbound_noise_cap": 0.30,
+    "top_op_share_drift": 0.15,
 }
 
 # The "perf columns" a comparison renders (record key, short label).
@@ -185,6 +194,40 @@ def compare_records(old: dict[str, Any],
                                   _num(new.get("sched_preemptions"))),
         }
 
+    # hotspot observatory (ISSUE 19): host-bound fraction + measured
+    # device time deltas, prediction-error factors, and per-op share
+    # drift across the union of both records' top-op tables
+    hotspots = None
+    old_hot = old.get("hotspots") or {}
+    new_hot = new.get("hotspots") or {}
+    if old_hot or new_hot:
+        def shares(block: dict[str, Any]) -> dict[str, float]:
+            out: dict[str, float] = {}
+            for row in block.get("top_ops") or []:
+                if isinstance(row, dict) and row.get("name"):
+                    value = _num(row.get("share"))
+                    if value is not None:
+                        out[str(row["name"])] = value
+            return out
+
+        old_shares, new_shares = shares(old_hot), shares(new_hot)
+        hotspots = {
+            "host_bound_fraction": _delta(
+                _num(old_hot.get("host_bound_fraction")),
+                _num(new_hot.get("host_bound_fraction"))),
+            "measured_round_device_s": _delta(
+                _num(old_hot.get("measured_round_device_s")),
+                _num(new_hot.get("measured_round_device_s"))),
+            "prediction_error_factor": _delta(
+                _num(old_hot.get("hotspot_prediction_error_factor")),
+                _num(new_hot.get("hotspot_prediction_error_factor"))),
+            "top_op_shares": {
+                name: _delta(old_shares.get(name), new_shares.get(name))
+                for name in sorted(set(old_shares) | set(new_shares))},
+            "books_close": {"old": old_hot.get("books_close"),
+                            "new": new_hot.get("books_close")},
+        }
+
     return {
         "old_id": old.get("record_id"),
         "new_id": new.get("record_id"),
@@ -204,6 +247,7 @@ def compare_records(old: dict[str, Any],
         "utilization": utilization,
         "counts": counts,
         "sched": sched,
+        "hotspots": hotspots,
     }
 
 
@@ -324,6 +368,33 @@ def rolling_baseline(records: list[dict[str, Any]],
                     if _num((r.get("utilization") or {}).get(k)) is not None}}
     if not any(v is not None for v in baseline["utilization"].values()):
         baseline["utilization"] = None
+    # hotspot peers (ISSUE 19): median host-bound fraction + the pooled
+    # per-peer fractions (the gate's noise floor — same design as
+    # sched_wait_peers) and per-name median top-op shares
+    peer_fractions = [
+        f for f in (_num((r.get("hotspots") or {})
+                         .get("host_bound_fraction")) for r in peers)
+        if f is not None]
+    if peer_fractions:
+        share_pool: dict[str, list[float]] = {}
+        for record in peers:
+            for row in (record.get("hotspots") or {}).get("top_ops") or []:
+                if isinstance(row, dict) and row.get("name"):
+                    value = _num(row.get("share"))
+                    if value is not None:
+                        share_pool.setdefault(
+                            str(row["name"]), []).append(value)
+        baseline["hotspots"] = {
+            "host_bound_fraction": round(
+                statistics.median(peer_fractions), 4),
+            "hostbound_peers": [round(f, 4) for f in peer_fractions],
+            "measured_round_device_s": median_of(
+                ("hotspots", "measured_round_device_s")),
+            "top_ops": [
+                {"name": name,
+                 "share": round(statistics.median(values), 4)}
+                for name, values in sorted(share_pool.items())],
+        }
     baseline["counts"] = {}
     baseline["time_attribution"] = {}
     return baseline
@@ -462,6 +533,60 @@ def regress_check(baseline: dict[str, Any], candidate: dict[str, Any],
                 "candidate": round(cand_wait, 3),
                 "allowed": round(allowed, 3),
                 "peers": len(peer_waits),
+            })
+
+    # --- hotspots: host-bound-fraction rise (ISSUE 19) ----------------
+    # Absolute rise past the baseline, floored by the peers' own spread
+    # (pooled fractions when the rolling baseline carries them) and
+    # capped — the dispatch-gap diagnosis is exactly what the
+    # sweep-regroup work moves, so a silent host-bound drift must fail
+    # loudly, but a baseline that itself wobbles 0.2 can't gate at 0.15.
+    base_hot = baseline.get("hotspots") or {}
+    cand_hot = candidate.get("hotspots") or {}
+    old_hb = _num(base_hot.get("host_bound_fraction"))
+    new_hb = _num(cand_hot.get("host_bound_fraction"))
+    if old_hb is not None and new_hb is not None:
+        checks += 1
+        peer_fractions = [f for f in
+                          (_num(x) for x in
+                           base_hot.get("hostbound_peers") or [])
+                          if f is not None]
+        spread = (max(peer_fractions) - min(peer_fractions)
+                  if len(peer_fractions) >= 2 else 0.0)
+        hb_threshold = min(max(th["hostbound_rise"], spread),
+                           th["hostbound_noise_cap"])
+        if (new_hb - old_hb) > hb_threshold:
+            violations.append({
+                "check": "hotspots:host_bound_fraction",
+                "baseline": round(old_hb, 4),
+                "candidate": round(new_hb, 4),
+                "rise": round(new_hb - old_hb, 4),
+                "threshold": round(hb_threshold, 4),
+            })
+
+    # --- hotspots: top-op self-time share drift (ISSUE 19) ------------
+    # Either direction, ops named in BOTH top tables only (an op absent
+    # from one side is a table-depth artifact, not evidence).
+    def _shares(block: dict[str, Any]) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for row in block.get("top_ops") or []:
+            if isinstance(row, dict) and row.get("name"):
+                value = _num(row.get("share"))
+                if value is not None:
+                    out[str(row["name"])] = value
+        return out
+
+    base_shares, cand_shares = _shares(base_hot), _shares(cand_hot)
+    for name in sorted(set(base_shares) & set(cand_shares)):
+        checks += 1
+        drift = cand_shares[name] - base_shares[name]
+        if abs(drift) > th["top_op_share_drift"]:
+            violations.append({
+                "check": f"hotspots:op_share:{name}",
+                "baseline": round(base_shares[name], 4),
+                "candidate": round(cand_shares[name], 4),
+                "drift": round(drift, 4),
+                "threshold": th["top_op_share_drift"],
             })
 
     # --- numerics: non-finite values are never an acceptable delta ----

@@ -176,11 +176,20 @@ def mine_attribution(events: list[dict[str, Any]],
 def derive_record(events: list[dict[str, Any]],
                   trace_events: list[dict[str, Any]] | None = None,
                   fingerprint: str | None = None,
-                  source: str = "run") -> dict[str, Any] | None:
+                  source: str = "run",
+                  ledger_records: list[dict[str, Any]] | None = None
+                  ) -> dict[str, Any] | None:
     """Distill one run's event slice (+ optional trace spans) into a
-    ledger record.  Returns None for an empty slice (nothing ran)."""
+    ledger record.  Returns None for an empty slice (nothing ran).
+
+    ``ledger_records`` (optional) is the existing corpus: when given and
+    the run carried profiling windows, the hotspot observatory's
+    measured per-round device time is reconciled against the cost
+    observatory's prediction (``hotspot_prediction_error_factor``,
+    the symmetric max(p/a, a/p) convention of costmodel/estimate)."""
     from attackfl_tpu.costmodel.report import profiles_from_events
     from attackfl_tpu.costmodel.roofline import utilization_summary
+    from attackfl_tpu.profiler.mine import hotspots_from_events
     from attackfl_tpu.telemetry.forensics import forensics_summary
     from attackfl_tpu.telemetry.numerics import numerics_summary
     from attackfl_tpu.telemetry.summary import summarize
@@ -317,6 +326,33 @@ def derive_record(events: list[dict[str, Any]],
             (attribution["device_compute_s"] / rounds) if rounds else None,
             device_kind, mesh_devices=mesh_devices)
 
+    # hotspot observatory (ISSUE 19, schema v14): the run's mined
+    # profiling windows distilled into the compact block (top ops,
+    # category shares, host-bound fraction, window status counts), plus
+    # the join against the cost observatory when a corpus is at hand —
+    # measured Σ device-busy / Σ profiled rounds priced against
+    # predict_device_time's peers-first estimate.  None when the run
+    # profiled nothing; a run whose every window degraded still records
+    # the status counts (unavailable windows are evidence, not holes).
+    hotspots = hotspots_from_events(events)
+    if hotspots is not None:
+        from attackfl_tpu.costmodel.estimate import (
+            predict_device_time, prediction_error_factor,
+        )
+
+        measured = hotspots.get("measured_round_device_s")
+        predicted = None
+        if measured is not None and ledger_records:
+            prediction = predict_device_time(
+                ledger_records, fingerprint or "", profile=utilization)
+            if prediction is not None:
+                predicted, info = prediction
+                hotspots["prediction_method"] = info.get("method")
+        hotspots["predicted_round_device_s"] = (
+            round(predicted, 6) if predicted is not None else None)
+        hotspots["hotspot_prediction_error_factor"] = \
+            prediction_error_factor(predicted, measured)
+
     steady = rates.get("rounds_per_sec_steady")
     record: dict[str, Any] = {
         "ledger_schema": LEDGER_SCHEMA_VERSION,
@@ -373,6 +409,7 @@ def derive_record(events: list[dict[str, Any]],
         "compile": compile_info,
         "programs": programs,
         "utilization": utilization,
+        "hotspots": hotspots,
         "numerics": numerics_out,
         "forensics": forensics_out,
         "counts": counts,

@@ -1,0 +1,37 @@
+"""Hotspot observatory (ISSUE 19): the sixth observability layer.
+
+Three halves, one pipeline:
+
+* **capture** (:mod:`attackfl_tpu.profiler.capture`, the only jax-using
+  module here): structured ``jax.profiler`` windows at every executor's
+  dispatch seam (sync / fused / pipelined / matrix), hardening the PR-2
+  ``--profile-rounds`` path — fail-open when the profiler backend is
+  unavailable, each window closed with a schema-v14 ``hotspot`` event
+  carrying the trace artifact path, the window's rounds, the program
+  name and the mined compact summary;
+* **mine** (:mod:`attackfl_tpu.profiler.mine`, jax-free stdlib
+  gzip+json): Chrome-trace ``*.trace.json.gz`` files -> per-op /
+  per-fusion device-time attribution grouped by program (top-K op
+  table, per-category rollup, dispatch-gap diagnosis with a measured
+  host-bound fraction), under the books-close invariant
+  Σ op self-time <= device busy <= wall x lanes — torn/partial traces
+  counted loudly, never silently dropped;
+* **join** (:mod:`attackfl_tpu.ledger.record` + ``hotspots diff``):
+  measured per-program device time reconciled against the cost
+  observatory's predictions (``hotspot_prediction_error_factor``, the
+  symmetric max(p/a, a/p) convention from costmodel/estimate.py), the
+  compact ``hotspots`` block folded into ledger records, and
+  noise-floored ``ledger regress`` gates on host-bound-fraction rise
+  and top-op share drift.
+
+CLI: ``attackfl-tpu hotspots [show|diff] [--json]``
+(:mod:`attackfl_tpu.profiler.cli`).
+"""
+
+from attackfl_tpu.profiler.mine import (  # noqa: F401
+    HOST_BOUND_THRESHOLD,
+    hotspots_from_events,
+    mine_profile_dir,
+    mine_trace,
+    op_category,
+)

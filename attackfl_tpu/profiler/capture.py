@@ -1,0 +1,210 @@
+"""Structured profiling windows at the executors' dispatch seams.
+
+The jax-facing half of the hotspot observatory: :class:`HotspotCapture`
+wraps the PR-2 ``--profile-rounds`` machinery (``jax.profiler``
+start/stop around a 1-based inclusive round window) and hardens it:
+
+* **fail-open** — a missing/unwritable profile directory or a raising
+  ``jax.profiler.start_trace`` degrades to a schema-v14 ``hotspot``
+  event with ``status: unavailable`` plus a counter; the run itself is
+  never affected, and the window is spent so a broken backend is asked
+  exactly once, not every round;
+* **structured close** — each window that does open is stopped at the
+  seam, its new ``*.trace.json.gz`` artifact located and mined inline
+  (:mod:`attackfl_tpu.profiler.mine` — stdlib-only, microseconds of
+  work), and emitted as one ``hotspot`` event per artifact carrying the
+  trace path, the window rounds, the dispatch program name
+  (sync / fused / pipelined / matrix) and the compact attribution
+  summary (top ops, category shares, host-bound fraction, books);
+* **live surfacing** — the summary is pushed to the run monitor when
+  one is attached (``/hotspots`` route + the
+  ``attackfl_host_bound_fraction`` gauge).
+
+Legacy ``profile`` start/stop/start_failed events keep flowing for the
+old tooling; the ``hotspot`` record is the new, mined contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from attackfl_tpu.profiler.mine import (
+    compact_summary,
+    find_traces,
+    mine_trace,
+)
+from attackfl_tpu.telemetry.console import print_with_color
+
+
+def _short(error: BaseException) -> str:
+    return f"{type(error).__name__}: {error}"[:300]
+
+
+class HotspotCapture:
+    """One profiling window per run, opened/closed at dispatch seams.
+
+    ``window`` is the parsed ``(first, last)`` inclusive round range
+    (from ``telemetry.hotspots`` or, compatibly, ``profile_rounds``) or
+    None for no profiling.  The engine's ``_maybe_start_profile`` /
+    ``_maybe_stop_profile`` delegate here 1:1.
+    """
+
+    def __init__(self, telemetry: Any,
+                 window: tuple[int, int] | None,
+                 monitor: Any = None) -> None:
+        self.telemetry = telemetry
+        self.window = window if telemetry.enabled else None
+        self.monitor = monitor
+        self._active = False
+        self._program = ""
+        self._first = 0
+        self._last = 0
+        self._path = ""
+        self._seen: frozenset[str] = frozenset()
+
+    @property
+    def profiling(self) -> bool:
+        return self._active
+
+    # -- open ----------------------------------------------------------
+
+    def maybe_start(self, first_round: int,
+                    last_round: int | None = None,
+                    program: str = "sync") -> None:
+        """Open the trace when [first_round, last_round] overlaps the
+        window.  Fused chunks pass their whole round range (the chunk is
+        one dispatch; profiling starts at its boundary).  ``program``
+        names the dispatch seam for the window's ``hotspot`` event."""
+        if self.window is None or self._active:
+            return
+        start, stop = self.window
+        last_round = first_round if last_round is None else last_round
+        if last_round < start or first_round > stop:
+            return
+        path = os.path.join(self.telemetry.base_dir or ".", "profile")
+        # Preflight the artifact directory BEFORE asking the backend —
+        # an unwritable disk degrades the window, never the run.
+        try:
+            os.makedirs(path, exist_ok=True)
+            probe = os.path.join(path, ".hotspot_writable")
+            with open(probe, "w"):
+                pass
+            os.remove(probe)
+        except OSError as e:
+            self._degrade(path, first_round, last_round, program,
+                          f"profile dir unwritable ({_short(e)})")
+            return
+        self._seen = frozenset(find_traces(path))
+        try:
+            import jax  # deferred: mine/CLI paths never pay this
+
+            jax.profiler.start_trace(path)
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            self._degrade(path, first_round, last_round, program,
+                          f"start_trace failed ({_short(e)})")
+            return
+        self._active = True
+        self._program = program
+        self._first = first_round
+        self._last = max(last_round, first_round)
+        self._path = path
+        self.telemetry.events.emit("profile", action="start", path=path,
+                                   round=first_round)
+
+    def _degrade(self, path: str, first: int, last: int, program: str,
+                 reason: str) -> None:
+        """Fail-open: one loud unavailable record + counter, window
+        spent (no retry storm), run untouched."""
+        self.telemetry.events.emit(
+            "profile", action="start_failed", path=path, error=reason)
+        self.telemetry.events.emit(
+            "hotspot", status="unavailable", program=program,
+            round_first=first, round_last=max(last, first), reason=reason)
+        self.telemetry.counters.inc("hotspot_windows_unavailable")
+        print_with_color(
+            f"[hotspots] window unavailable: {reason}", "yellow")
+        self.window = None
+
+    # -- close ---------------------------------------------------------
+
+    def maybe_stop(self, completed_rounds: int = 0,
+                   force: bool = False) -> None:
+        """Close the trace once the window's last round completed (or on
+        ``force`` at run end), mine the artifact(s) and emit one
+        ``hotspot`` event per trace file."""
+        if not self._active:
+            return
+        if not force and completed_rounds < self.window[1]:
+            return
+        self._active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            reason = f"stop_trace failed ({_short(e)})"
+            self.telemetry.events.emit(
+                "profile", action="stop_failed", error=_short(e))
+            self.telemetry.events.emit(
+                "hotspot", status="unavailable", program=self._program,
+                round_first=self._first, round_last=self._last,
+                reason=reason)
+            self.telemetry.counters.inc("hotspot_windows_unavailable")
+            return
+        self.telemetry.events.emit("profile", action="stop",
+                                   round=completed_rounds)
+        # the trace stayed open until here: the window's true coverage
+        # runs to the last completed round (the sync seam starts with a
+        # single round number but profiles through the window's end)
+        if completed_rounds > self._last:
+            self._last = int(completed_rounds)
+        try:
+            self._emit_window()
+        except Exception as e:  # noqa: BLE001 — mining must not kill a run
+            self.telemetry.events.emit(
+                "hotspot", status="torn", program=self._program,
+                round_first=self._first, round_last=self._last,
+                reason=f"mining failed ({_short(e)})")
+            self.telemetry.counters.inc("hotspot_windows_torn")
+
+    def _emit_window(self) -> None:
+        new = [p for p in find_traces(self._path)
+               if p not in self._seen]
+        if not new:
+            # the backend stopped cleanly but wrote nothing — counted,
+            # not hidden
+            self.telemetry.events.emit(
+                "hotspot", status="empty", program=self._program,
+                round_first=self._first, round_last=self._last,
+                reason="no trace artifact written")
+            self.telemetry.counters.inc("hotspot_windows_empty")
+            return
+        base = self.telemetry.base_dir or "."
+        for path in new:
+            report = mine_trace(path)
+            status = report["status"]
+            summary = compact_summary(report)
+            self.telemetry.events.emit(
+                "hotspot", status=status, program=self._program,
+                round_first=self._first, round_last=self._last,
+                trace=os.path.relpath(path, base), **summary)
+            self.telemetry.counters.inc(f"hotspot_windows_{status}")
+            if status == "ok":
+                fraction = report.get("host_bound_fraction")
+                top = summary["top_ops"][0]["name"] \
+                    if summary["top_ops"] else "-"
+                print_with_color(
+                    f"[hotspots] {self._program} rounds "
+                    f"{self._first}-{self._last}: top={top} "
+                    f"hostbound={fraction} "
+                    f"({report.get('classification')})", "cyan")
+                if self.monitor is not None:
+                    set_hotspots = getattr(self.monitor, "set_hotspots",
+                                           None)
+                    if set_hotspots is not None:
+                        set_hotspots({
+                            "program": self._program,
+                            "round_first": self._first,
+                            "round_last": self._last,
+                            **summary})

@@ -165,6 +165,22 @@ Schema v13 (ISSUE 17) extends v12 — every v1-v12 file still validates:
   like the ledger append: a sweep whose science distillation raises is
   still a finished sweep.
 
+Schema v14 (ISSUE 19) extends v13 — every v1-v13 file still validates:
+
+* ``hotspot`` — the hotspot observatory's profiling-window record
+  (:mod:`attackfl_tpu.profiler`): one record per ``--hotspots`` /
+  ``--profile-rounds`` window closed at an executor's dispatch seam.
+  ``status`` is required (``ok`` / ``unavailable`` — the fail-open
+  degradation when the profiler backend cannot start — / ``torn`` /
+  ``empty``); everything else is OPTIONAL typed payload: the window
+  identity (``program``, ``round_first``/``round_last``, ``trace``
+  artifact path) and the mined compact attribution (``wall_us`` /
+  ``device_busy_us`` / ``op_self_us``, ``host_bound_fraction`` +
+  ``classification`` from the dispatch-gap diagnosis, ``books_close``,
+  ``top_ops`` rows, ``category_shares``, ``lanes``, ``reason`` on
+  degradation).  A window that failed to mine still leaves a record —
+  torn traces are counted, never silently dropped.
+
 Recording is strictly host-side: only values already materialized per
 round (metrics dicts, timer durations) are written — never callbacks
 inside traced/jitted code.  The numerics rows respect the same contract:
@@ -181,7 +197,7 @@ import time
 import uuid
 from typing import Any
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 # Required fields per event kind (beyond the common envelope).  Extra
 # fields are always allowed; these are the floor the tooling relies on.
@@ -261,6 +277,27 @@ REQUIRED_FIELDS: dict[str, dict[str, Any]] = {
     # sweep.  Everything beyond the sweep identity is OPTIONAL (below) —
     # a sweep too small to rank still leaves a record
     "science": {"sweep_id": str},
+    # --- schema v14 kind (ISSUE 19) ---
+    # hotspot-observatory profiling window (attackfl_tpu/profiler): one
+    # record per window closed at an executor dispatch seam.  Only the
+    # status is required (ok/unavailable/torn/empty) — a window whose
+    # backend refused to start, or whose trace tore, still leaves a
+    # loud record.  The mined attribution rides as OPTIONAL typed
+    # fields (below)
+    "hotspot": {"status": str},
+}
+
+# --- schema v14: optional attribution payload on `hotspot` events ---
+# (type-checked when present; an `unavailable` window carries only the
+# identity + reason, an `ok` window carries the mined compact summary —
+# see profiler/mine.compact_summary)
+_OPTIONAL_HOTSPOT_FIELDS: dict[str, Any] = {
+    "program": str, "round_first": int, "round_last": int,
+    "trace": str, "reason": str,
+    "wall_us": _NUM, "device_busy_us": _NUM, "op_self_us": _NUM,
+    "host_bound_fraction": _NUM, "classification": str,
+    "books_close": bool, "lanes": int,
+    "top_ops": list, "category_shares": dict,
 }
 
 # --- schema v13: optional leaderboard payload on `science` events ---
@@ -366,6 +403,8 @@ KINDS_BY_VERSION: dict[int, frozenset[str]] = {
     12: frozenset({"slot"}),
     # + the optional leaderboard payload on the new kind itself
     13: frozenset({"science"}),
+    # + the optional attribution payload on the new kind itself
+    14: frozenset({"hotspot"}),
 }
 
 
@@ -495,6 +534,18 @@ def validate_event(record: Any) -> list[str]:
                     errors.append(
                         f"[science] '{name}' has type "
                         f"{type(record[name]).__name__}")
+        if kind == "hotspot":
+            for name, typ in _OPTIONAL_HOTSPOT_FIELDS.items():
+                if name not in record:
+                    continue
+                value = record[name]
+                if typ is bool:
+                    if not isinstance(value, bool):
+                        errors.append(f"[hotspot] '{name}' must be bool")
+                elif isinstance(value, bool) or not isinstance(value, typ):
+                    errors.append(
+                        f"[hotspot] '{name}' has type "
+                        f"{type(value).__name__}")
     schema = record.get("schema")
     if isinstance(schema, int) and schema > SCHEMA_VERSION:
         errors.append(f"schema version {schema} is newer than "

@@ -206,6 +206,11 @@ class RunMonitor:
         # the engine at each AOT-compile seam — backs /programs and the
         # attackfl_program_flops / attackfl_utilization gauges
         self._cost_programs: dict[str, dict[str, Any]] = {}
+        # hotspot observatory (ISSUE 19): the latest mined profiling
+        # window per program seam, pushed by HotspotCapture at window
+        # close — backs /hotspots and the attackfl_host_bound_fraction
+        # gauge
+        self._hotspots: dict[str, dict[str, Any]] = {}
         # cross-run ledger (ISSUE 7): /runs lists the store's index so a
         # live monitor also answers "how does this run compare to the
         # last ones" — set by the engine when the ledger is enabled
@@ -233,6 +238,7 @@ class RunMonitor:
         self._server.route("GET", "/last-round", self._route_last_round)
         self._server.route("GET", "/runs", self._route_runs)
         self._server.route("GET", "/programs", self._route_programs)
+        self._server.route("GET", "/hotspots", self._route_hotspots)
         self._server.start()
         self.port = self._server.port
         threading.Thread(target=self._watchdog_loop,
@@ -309,6 +315,21 @@ class RunMonitor:
         gauges."""
         with self._lock:
             self._cost_programs = dict(programs or {})
+
+    def set_hotspots(self, summary: dict[str, Any]) -> None:
+        """Record a closed profiling window's mined summary (ISSUE 19)
+        — called by HotspotCapture; keyed by the dispatch-seam program
+        name so a run that profiles several seams keeps one latest
+        window per seam.  Backs /hotspots and the
+        ``attackfl_host_bound_fraction`` gauge."""
+        with self._lock:
+            self._hotspots[str(summary.get("program") or "?")] = \
+                dict(summary)
+
+    def hotspots_report(self) -> dict[str, Any]:
+        """``/hotspots`` payload: the latest mined window per seam."""
+        with self._lock:
+            return {"windows": dict(self._hotspots)}
 
     def cost_report(self) -> dict[str, Any]:
         """``/programs`` payload: the static profiles plus a live
@@ -543,6 +564,18 @@ class RunMonitor:
                     lines.append(
                         f'attackfl_achieved_per_sec{{kind="{kind}"}} '
                         f'{value:.6g}')
+        with self._lock:
+            hotspots = {name: dict(window)
+                        for name, window in self._hotspots.items()}
+        if hotspots:
+            lines.append("# TYPE attackfl_host_bound_fraction gauge")
+            for program, window in sorted(hotspots.items()):
+                value = window.get("host_bound_fraction")
+                if isinstance(value, (int, float)) \
+                        and not isinstance(value, bool):
+                    lines.append(
+                        f'attackfl_host_bound_fraction'
+                        f'{{program="{_sanitize(program)}"}} {value:.6g}')
         counters = self._tel.counters.snapshot()
         if counters:
             lines.append("# TYPE attackfl_counter counter")
@@ -570,6 +603,9 @@ class RunMonitor:
 
     def _route_programs(self, query, body):
         return 200, self.cost_report()
+
+    def _route_hotspots(self, query, body):
+        return 200, self.hotspots_report()
 
 
 def _is_plain(value: Any) -> bool:

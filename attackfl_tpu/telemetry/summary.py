@@ -191,6 +191,14 @@ def summarize(events: list[dict[str, Any]]) -> dict[str, Any]:
                           "path": resume.get("path"),
                           "source_run_id": resume.get("source_run_id")}
                          if resume else None),
+        # hotspot observatory (schema v14, ISSUE 19): one row per
+        # profiling window — status + the mined headline numbers
+        "hotspots": [{k: e.get(k) for k in
+                      ("status", "program", "round_first", "round_last",
+                       "host_bound_fraction", "classification",
+                       "books_close", "trace", "reason")
+                      if e.get(k) is not None}
+                     for e in events if e.get("kind") == "hotspot"],
     }
 
 
@@ -226,6 +234,15 @@ def format_summary(summary: dict[str, Any]) -> str:
         lines.append(
             f"degrade: {transition.get('state')} at round "
             f"{transition.get('round')}")
+    for window in summary.get("hotspots") or []:
+        detail = (f" hostbound={window.get('host_bound_fraction')}"
+                  f" ({window.get('classification')})"
+                  if window.get("status") == "ok"
+                  else f" ({window.get('reason') or 'no attribution'})")
+        lines.append(
+            f"hotspot: {window.get('program')} rounds "
+            f"{window.get('round_first')}-{window.get('round_last')} "
+            f"{window.get('status')}{detail}")
     if summary["phases"]:
         lines.append(f"{'phase':<14}{'p50':>10}{'p95':>10}{'mean':>10}{'n':>6}")
         for name, stats in summary["phases"].items():

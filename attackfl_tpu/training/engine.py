@@ -47,6 +47,7 @@ from attackfl_tpu.eval.validation import Validation
 from attackfl_tpu.models.hyper import make_cnn_hyper, make_hypernetwork
 from attackfl_tpu.ops import defenses
 from attackfl_tpu.ops import pytree as pt
+from attackfl_tpu.profiler.capture import HotspotCapture
 from attackfl_tpu.parallel.mesh import (
     broadcast_bytes, broadcast_string, gather_to_host, is_multiprocess,
     make_client_mesh, make_constrain, replicate_to_mesh,
@@ -345,11 +346,17 @@ class Simulator:
                 stall_factor=cfg.telemetry.stall_factor,
                 stall_grace_seconds=cfg.telemetry.stall_grace_seconds,
             )
-        # jax.profiler window (--profile-rounds A:B), device traces under
-        # <telemetry base>/profile
-        self._profile_window = (parse_profile_rounds(
-            cfg.telemetry.profile_rounds) if self.telemetry.enabled else None)
-        self._profiling = False
+        # hotspot observatory (ISSUE 19): the structured jax.profiler
+        # window (--hotspots A:B, superseding the legacy
+        # --profile-rounds spec) — fail-open capture at the dispatch
+        # seams, each closed window mined into a schema-v14 `hotspot`
+        # event (attackfl_tpu/profiler).  Device traces land under
+        # <telemetry base>/profile as before.
+        self._hotspots = HotspotCapture(
+            self.telemetry,
+            parse_profile_rounds(cfg.telemetry.hotspots
+                                 or cfg.telemetry.profile_rounds),
+            monitor=self.monitor)
 
         # ---- cross-run ledger (ISSUE 7) ---------------------------------
         # One distilled record per run, appended at _finish_run by pure
@@ -1256,9 +1263,17 @@ class Simulator:
                 trace_tail = trace_events[self._ledger_trace_offset:]
                 self._ledger_trace_offset = len(trace_events)
                 trace_events = trace_tail
+            # the existing corpus feeds the hotspot observatory's
+            # measured-vs-predicted join (this run isn't appended yet,
+            # so no self-exclusion is needed)
+            try:
+                corpus = self._ledger.records()
+            except Exception:  # noqa: BLE001 — the join is optional
+                corpus = None
             record = derive_record(
                 slice_events, trace_events=trace_events,
-                fingerprint=self._ckpt_manager.fingerprint)
+                fingerprint=self._ckpt_manager.fingerprint,
+                ledger_records=corpus)
             if record is None:
                 return
             rid = self._ledger.append(record)
@@ -1304,46 +1319,20 @@ class Simulator:
                 "`attackfl-tpu watch`)", "cyan")
 
     def _maybe_start_profile(self, first_round: int,
-                             last_round: int | None = None) -> None:
-        """Open the jax.profiler trace when the upcoming round(s)
-        [first_round, last_round] overlap the --profile-rounds window.
+                             last_round: int | None = None,
+                             program: str = "sync") -> None:
+        """Open the profiling window when the upcoming round(s)
+        [first_round, last_round] overlap --hotspots/--profile-rounds.
         Fused chunks pass their whole round range (the chunk is one
-        dispatch; profiling starts at its boundary)."""
-        if self._profile_window is None or self._profiling:
-            return
-        start, stop = self._profile_window
-        last_round = first_round if last_round is None else last_round
-        if last_round < start or first_round > stop:
-            return
-        path = os.path.join(self.telemetry.base_dir or ".", "profile")
-        try:
-            jax.profiler.start_trace(path)
-        except Exception as e:  # noqa: BLE001 — profiling is best-effort
-            self.telemetry.events.emit(
-                "profile", action="start_failed", path=path,
-                error=f"{type(e).__name__}: {e}"[:300])
-            self._profile_window = None  # don't retry every round
-            return
-        self._profiling = True
-        self.telemetry.events.emit("profile", action="start", path=path,
-                                   round=first_round)
+        dispatch; profiling starts at its boundary).  Delegates to the
+        hotspot observatory's fail-open capture (attackfl_tpu/profiler);
+        ``program`` names the dispatch seam on the ``hotspot`` event."""
+        self._hotspots.maybe_start(first_round, last_round,
+                                   program=program)
 
     def _maybe_stop_profile(self, completed_rounds: int = 0,
                             force: bool = False) -> None:
-        if not self._profiling:
-            return
-        if not force and completed_rounds < self._profile_window[1]:
-            return
-        try:
-            jax.profiler.stop_trace()
-        except Exception as e:  # noqa: BLE001
-            self.telemetry.events.emit(
-                "profile", action="stop_failed",
-                error=f"{type(e).__name__}: {e}"[:300])
-        else:
-            self.telemetry.events.emit("profile", action="stop",
-                                       round=completed_rounds)
-        self._profiling = False
+        self._hotspots.maybe_stop(completed_rounds, force=force)
 
     def close(self) -> None:
         """Release observability + persistence resources (monitor thread,
@@ -2113,7 +2102,8 @@ class Simulator:
                 includes_compile = (donate_key not in self._fused_cache
                                     and donate_key not in self._fused_exe_cache)
                 done_before = int(state["completed_rounds"])
-                self._maybe_start_profile(done_before + 1, done_before + n)
+                self._maybe_start_profile(done_before + 1, done_before + n,
+                                          program="fused")
                 t0 = time.perf_counter()
                 with tel.tracer.span("chunk", chunk_len=n):
                     state, metrics = self.run_scan(state, n)
@@ -2440,7 +2430,8 @@ class Simulator:
                 if want_more and len(queue) <= overlap():
                     broadcast += 1
                     target_round = completed + len(queue) + 1
-                    self._maybe_start_profile(target_round)
+                    self._maybe_start_profile(target_round,
+                                              program="pipelined")
                     with tel.tracer.span("dispatch", round=target_round,
                                          broadcast=broadcast):
                         if tel.enabled and self.mesh is None:
@@ -2636,7 +2627,7 @@ class Simulator:
                 round_no = int(state["completed_rounds"]) + 1
                 if verbose:
                     print_with_color(f"Start training round {round_no}", "yellow")
-                self._maybe_start_profile(round_no)
+                self._maybe_start_profile(round_no, program="sync")
                 state, metrics = self.run_round(state)
                 history.append(metrics)
                 self._note_round_faults(round_no, metrics["broadcast"])

@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from attackfl_tpu.config import Config
+from attackfl_tpu.config import Config, parse_profile_rounds
 from attackfl_tpu.costmodel.capture import compiled_profile
 from attackfl_tpu.data.synthetic import get_dataset
 from attackfl_tpu.eval.validation import Validation
@@ -65,6 +65,7 @@ from attackfl_tpu.matrix.grid import (
 from attackfl_tpu.matrix.program import build_cell_body, build_matrix_body
 from attackfl_tpu.matrix.records import cell_event_summaries, sweep_records
 from attackfl_tpu.ops import metrics as num_metrics
+from attackfl_tpu.profiler.capture import HotspotCapture
 from attackfl_tpu.ops import pytree as pt
 from attackfl_tpu.registry import get_model
 from attackfl_tpu.telemetry import Telemetry, print_with_color
@@ -304,6 +305,14 @@ class MatrixRun:
 
         # per-cell numerics drainers, lazily built at first resolve
         self._drainers: dict[str, NumericsDrainer] = {}
+
+        # hotspot observatory (ISSUE 19): the matrix seam gets its own
+        # profiling window — the sweep's chunk dispatch is exactly the
+        # program the warm-batched 0.61x question is about
+        self._hotspots = HotspotCapture(
+            self.telemetry,
+            parse_profile_rounds(cfg.telemetry.hotspots
+                                 or cfg.telemetry.profile_rounds))
 
     # ------------------------------------------------------------------
     # identity
@@ -692,6 +701,10 @@ class MatrixRun:
                     (n, donate) not in self._fused_cache
                     and (n, donate) not in self._matrix_exe_cache)
                 t0 = time.perf_counter()
+                # hotspot window around the chunk dispatch (the chunk is
+                # one device program; profiling starts at its boundary)
+                self._hotspots.maybe_start(completed + 1, completed + n,
+                                           program="matrix")
                 with tel.tracer.span("chunk", chunk_len=n, matrix=True):
                     fn = self._matrix_chunk(n, donate)
                     # AOT seam (cost observatory): dispatch the profiled
@@ -710,6 +723,7 @@ class MatrixRun:
                     self._resolve_chunk(metrics, n, histories, consecutive)
                 elapsed = time.perf_counter() - t0
                 completed = self._min_completed(state)
+                self._hotspots.maybe_stop(completed)
                 tel.events.emit(
                     "matrix", sweep_id=self.sweep_id, action="chunk",
                     chunk_len=n, seconds=round(elapsed, 6),
@@ -856,6 +870,7 @@ class MatrixRun:
                 t_start: float, interrupted: bool) -> None:
         tel = self.telemetry
         wall = time.perf_counter() - t_start
+        self._hotspots.maybe_stop(force=True)
         records = self._distill_records(histories, wall)
         self._append_ledger_records(records)
         if tel.enabled:

@@ -701,6 +701,151 @@ def measure_matrix_compare(rounds: int, log_path: str, reps: int = 2,
     return out
 
 
+def measure_hotspots_matrix(rounds: int, log_path: str) -> dict:
+    """Profile the warm dispatch paths the matrix hypothesis argues
+    about (ISSUE 19, the ROADMAP sweep-dispatch item): one warm serial
+    cell, the warm batched sweep over a representative grid, and a
+    fedavg-only batched control, each under a ``jax.profiler`` window
+    mined by :mod:`attackfl_tpu.profiler.mine`.
+
+    The evidence target: BENCH_MATRIX's 0.61× warm speedup is blamed on
+    the vmapped ``lax.switch`` computing every aggregation branch.  On
+    this backend the switch lowers to select fusions — a profiled
+    matrix program shows NO ``conditional`` HLO — so the measurable
+    branch signature is the robust-aggregation work the training step
+    never emits: ``sort`` (median / trimmed-mean / krum distances) plus
+    the ``select`` mux fusions.  ReLU backward also emits selects, so
+    the fedavg-only batched control differences training + dispatch
+    away: full-grid signature share minus control share = the
+    all-branches aggregation share actually paid per warm dispatch.
+    The per-variant host-bound fractions say how much of the remaining
+    gap is dispatch, not device work."""
+    import os
+    import shutil
+
+    import jax
+
+    from attackfl_tpu.config import TelemetryConfig, audit_config
+    from attackfl_tpu.matrix.grid import cell_config, expand_cells, \
+        grid_from_dict
+    from attackfl_tpu.profiler.mine import find_traces, mine_trace
+    from attackfl_tpu.training.engine import Simulator
+    from attackfl_tpu.training.matrix_exec import MatrixRun
+
+    os.makedirs(log_path, exist_ok=True)
+    base = audit_config(
+        prng_impl="threefry2x32",
+        telemetry=TelemetryConfig(enabled=False),
+        log_path=log_path, checkpoint_dir=log_path)
+    attacks = [{"mode": "none"}, {"mode": "LIE"}]
+    robust = ["fedavg", "median", "trimmed_mean", "krum"]
+
+    def _grid(defenses):
+        return grid_from_dict({
+            "attacks": attacks, "attack-clients": 1, "attack-round": 2,
+            "defenses": defenses, "seeds": [1], "rounds": rounds,
+        })
+
+    def _profiled(tag, fn):
+        """Warm the variant once untimed, then run it again inside a
+        profiler window; mine the written trace."""
+        fn()
+        path = os.path.join(log_path, f"hotspots_{tag}")
+        shutil.rmtree(path, ignore_errors=True)
+        jax.profiler.start_trace(path)
+        t0 = time.perf_counter()
+        fn()
+        wall = time.perf_counter() - t0
+        jax.profiler.stop_trace()
+        traces = find_traces(path)
+        report = mine_trace(traces[-1]) if traces else None
+        return round(wall, 3), report
+
+    def _signature_rows(report):
+        rows = []
+        for row in report["ops"]:
+            tokens = set(row["name"].replace("-", "_")
+                         .replace(".", "_").split("_"))
+            if tokens & {"sort", "select", "conditional"}:
+                rows.append(row)
+        return rows
+
+    def _summary(wall, report):
+        signature = _signature_rows(report)
+        return {
+            "warm_wall_s": wall,
+            "device_busy_us": report["device_busy_us"],
+            "trace_wall_us": report["wall_us"],
+            "host_bound_fraction": report["host_bound_fraction"],
+            "classification": report["classification"],
+            "books_close": report["books"]["close"],
+            "category_shares": {
+                name: bucket["share"]
+                for name, bucket in sorted(report["categories"].items())},
+            "top_ops": [
+                {"name": r["name"], "category": r["category"],
+                 "share": r["share"]} for r in report["ops"][:5]],
+            "aggregation_signature_share": round(
+                sum(r["share"] for r in signature), 4),
+            "aggregation_signature_ops": [
+                {"name": r["name"], "share": r["share"]}
+                for r in signature[:6]],
+        }
+
+    full_grid = _grid(robust)
+    cell = next(c for c in expand_cells(full_grid)
+                if c.attack.mode == "LIE" and c.defense == "fedavg")
+    serial_sim = Simulator(cell_config(base, cell, rounds=rounds))
+
+    def run_serial():
+        state = serial_sim.init_state()
+        if serial_sim.supports_fused():
+            serial_sim.run_fast(num_rounds=rounds, state=state,
+                                save_checkpoints=False, verbose=False)
+        else:
+            serial_sim.run(num_rounds=rounds, state=state,
+                           save_checkpoints=False, verbose=False)
+
+    full_runner = MatrixRun(base, full_grid)
+    control_runner = MatrixRun(base, _grid(["fedavg"]))
+
+    out: dict = {
+        "config": f"hotspots-matrix: audit workload, "
+                  f"{len(attacks)} attacks x {len(robust)} defenses x "
+                  f"1 seed = {full_grid.n_cells} cells, {rounds} rounds; "
+                  f"control = same attacks x fedavg only",
+    }
+    wall, report = _profiled("serial_cell", run_serial)
+    out["serial_cell"] = _summary(wall, report)
+    wall, report = _profiled(
+        "batched_full",
+        lambda: full_runner.run(save_checkpoints=False, verbose=False))
+    out["batched_full"] = _summary(wall, report)
+    wall, report = _profiled(
+        "batched_fedavg_only",
+        lambda: control_runner.run(save_checkpoints=False, verbose=False))
+    out["batched_fedavg_only"] = _summary(wall, report)
+
+    out["aggregation_branch_share"] = round(
+        out["batched_full"]["aggregation_signature_share"]
+        - out["batched_fedavg_only"]["aggregation_signature_share"], 4)
+    out["hostbound"] = {
+        "serial_cell": out["serial_cell"]["host_bound_fraction"],
+        "batched_full": out["batched_full"]["host_bound_fraction"],
+        "batched_fedavg_only":
+            out["batched_fedavg_only"]["host_bound_fraction"],
+    }
+    share = out["aggregation_branch_share"]
+    out["verdict"] = (
+        f"robust-aggregation branches cost {share:.1%} of batched device "
+        "self-time beyond the fedavg-only control"
+        + (" — all-branches switch overhead alone does NOT explain the "
+           "0.61x warm loss; see the host-bound fractions for the "
+           "dispatch side" if share < 0.2 else
+           " — consistent with the all-branches switch hypothesis"))
+    return out
+
+
 def measure_contention(log_path: str, jobs: int = 6, reps: int = 2) -> dict:
     """Multi-tenant contention bench (ISSUE 15): the SAME N-job mixed
     workload burst-submitted to an in-process RunService under the
@@ -1193,6 +1338,15 @@ def main() -> None:
                              "batched scenario-matrix program (5 attacks x "
                              "9 defenses, cold + warm walls, paired means; "
                              "--rounds rounds per cell)")
+    parser.add_argument("--hotspots-matrix", action="store_true",
+                        help="measure ONLY the profiled op-level "
+                             "attribution of the warm dispatch paths: "
+                             "one warm serial cell vs the warm batched "
+                             "sweep vs a fedavg-only batched control, "
+                             "each mined for host-bound fraction and "
+                             "the robust-aggregation branch share "
+                             "(evidence on the BENCH_MATRIX 0.61x "
+                             "lax.switch hypothesis; --rounds rounds)")
     parser.add_argument("--contention", action="store_true",
                         help="measure ONLY the multi-tenant contention "
                              "bench: a 6-job mixed-priority workload "
@@ -1234,17 +1388,19 @@ def main() -> None:
                       args.north_star, args.e2e_rounds is not None,
                       args.pipeline_compare, args.numerics_overhead,
                       args.depth_sweep, args.matrix_compare,
+                      args.hotspots_matrix,
                       args.mesh_sweep, args.contention,
                       args.compile_cache is not None))) > 1:
         parser.error("--config / --north-star / --e2e-rounds / "
                      "--pipeline-compare / --numerics-overhead / "
-                     "--depth-sweep / --matrix-compare / --mesh-sweep / "
+                     "--depth-sweep / --matrix-compare / --hotspots-matrix "
+                     "/ --mesh-sweep / "
                      "--contention / --compile-cache are exclusive")
     single = (args.config is not None or args.north_star
               or args.e2e_rounds is not None or args.pipeline_compare
               or args.numerics_overhead or args.depth_sweep
               or args.matrix_compare or args.mesh_sweep
-              or args.contention
+              or args.contention or args.hotspots_matrix
               or args.compile_cache is not None)
     if not single and (args.backend or args.clients or args.trace or args.dtype
                        or args.hyper_update):
@@ -1269,6 +1425,8 @@ def main() -> None:
         metric_name = "fl_depth_sweep_rounds_per_sec"
     elif args.matrix_compare:
         metric_name = "fl_matrix_vs_serial_sweep"
+    elif args.hotspots_matrix:
+        metric_name = "fl_hotspots_matrix_attribution"
     elif args.contention:
         metric_name = "fl_contention_sched_vs_serial"
     elif args.mesh_sweep:
@@ -1422,6 +1580,20 @@ def main() -> None:
             metric_name, res["speedup_cold"], unit="x",
             speedup_warm=res["speedup_warm"],
             compile_once_saving_s=res["compile_once_saving_s"],
+            detail=res,
+        )
+        ledger_append(line)
+        print(json.dumps(line))
+        return
+
+    if args.hotspots_matrix:
+        deadline_timer.cancel()
+        res = measure_hotspots_matrix(args.rounds, "/tmp/attackfl_bench")
+        partial.update(res)
+        line = metric_line(
+            metric_name, res["aggregation_branch_share"], unit="share",
+            hostbound=res["hostbound"],
+            verdict=res["verdict"],
             detail=res,
         )
         ledger_append(line)

@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# One-shot hotspot-observatory smoke gate (ISSUE 19 tentpole), the
+# sibling of scripts/science_smoke.sh: runs a REAL tiny profiled run
+# (--hotspots 2:3 on the sync executor), then asserts the observatory
+# closes end to end — the spool carries a schema-v14 `hotspot` event
+# whose books close, `hotspots show` reproduces the attribution straight
+# from the written trace tree, diff-vs-self passes the drift gate
+# (exit 0), a missing tree fails loudly (exit 1), and the run's ledger
+# record carries the joined hotspots block.  Used by tier-1 through
+# tests/test_hotspots.py; run it directly before a PR.
+#
+# Usage: scripts/hotspots_smoke.sh [work-dir]  (default: a fresh tmp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# the pytest session routes telemetry to its own tmp dir (conftest);
+# this smoke asserts on the run's OWN spool path, so undo that here
+unset ATTACKFL_TELEMETRY_DIR
+# share the persistent compile cache so repeat smokes skip the compile
+export ATTACKFL_COMPILE_CACHE="${ATTACKFL_COMPILE_CACHE:-/tmp/attackfl_jax_cache}"
+
+WORK="${1:-$(mktemp -d /tmp/attackfl_hotspots_smoke.XXXXXX)}"
+mkdir -p "$WORK"
+export ATTACKFL_LEDGER_DIR="$WORK/ledger"
+CFG="$WORK/config.yaml"
+cat > "$CFG" <<YAML
+log_path: $WORK
+checkpoint-dir: $WORK/ckpt
+server:
+  num-round: 3
+  clients: 4
+  mode: fedavg
+  model: CNNModel
+  data-name: ICU
+  validation: true
+  train-size: 256
+  test-size: 128
+  random-seed: 1
+  data-distribution:
+    num-data-range: [48, 64]
+learning:
+  epoch: 1
+  batch-size: 32
+YAML
+
+echo "--- real profiled run: 3 rounds, hotspot window 2:3"
+python -m attackfl_tpu run --config "$CFG" --no-wait --hotspots 2:3
+
+echo "--- spool carries a books-closing schema-v14 hotspot event"
+python scripts/check_event_schema.py "$WORK/events.jsonl"
+python - "$WORK/events.jsonl" <<'PY'
+import json
+import sys
+
+events = [json.loads(line) for line in open(sys.argv[1])]
+hotspots = [e for e in events if e["kind"] == "hotspot"]
+assert hotspots, "no hotspot event in the spool"
+ok = [e for e in hotspots if e["status"] == "ok"]
+assert ok, f"no OK window: {[e['status'] for e in hotspots]}"
+window = ok[0]
+assert window["schema"] == 14, window["schema"]
+assert window["books_close"] is True, "books failed to close"
+assert window["top_ops"], "empty attribution"
+assert 0.0 <= window["host_bound_fraction"] <= 1.0
+print(f"hotspot window: program={window['program']} "
+      f"rounds {window['round_first']}-{window['round_last']} "
+      f"top={window['top_ops'][0]['name']} "
+      f"hostbound={window['host_bound_fraction']}")
+PY
+
+echo "--- hotspots show reproduces the attribution from the trace tree"
+python -m attackfl_tpu hotspots show "$WORK" | tee "$WORK/show.out"
+grep -q "books close: True" "$WORK/show.out" \
+    || { echo "mined report's books do not close" >&2; exit 1; }
+
+echo "--- drift gate: diff-vs-self must pass"
+python -m attackfl_tpu hotspots diff "$WORK" "$WORK"
+
+echo "--- a missing trace tree must fail loudly"
+if python -m attackfl_tpu hotspots show "$WORK/definitely-absent" \
+    > /dev/null 2>&1; then
+    echo "hotspots show passed on a missing tree" >&2
+    exit 1
+fi
+
+echo "--- ledger record carries the joined hotspots block"
+python - "$ATTACKFL_LEDGER_DIR/ledger.jsonl" <<'PY'
+import json
+import sys
+
+records = [json.loads(line) for line in open(sys.argv[1])]
+blocks = [r["hotspots"] for r in records if r.get("hotspots")]
+assert blocks, "no ledger record carries a hotspots block"
+block = blocks[-1]
+assert block["status_counts"].get("ok"), block
+assert block["measured_round_device_s"] is not None
+print(f"ledger join: measured {block['measured_round_device_s']}s/round "
+      f"device time over {block['profiled_rounds']} profiled round(s)")
+PY
+echo "hotspots smoke: OK"

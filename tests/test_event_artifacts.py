@@ -34,6 +34,7 @@ def test_committed_event_artifacts_validate(capsys):
     assert "tests/data/events.v11.jsonl" in names
     assert "tests/data/events.v12.jsonl" in names
     assert "tests/data/events.v13.jsonl" in names
+    assert "tests/data/events.v14.jsonl" in names
     assert lint.main([str(REPO)]) == 0, capsys.readouterr().out
 
 

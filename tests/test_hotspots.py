@@ -1,0 +1,684 @@
+"""Hotspot observatory (ISSUE 19): trace mining + op attribution, the
+books-close invariant, dispatch-gap diagnosis, torn-trace accounting,
+the hotspot -> ledger join with the cost-observatory reconciliation, the
+regress gates, the `hotspots` CLI, fail-open capture, schema v14, and
+the one-shot smoke gate.
+
+Golden values come from the committed corpus
+``tests/data/profile_corpus/``: ``real/real.trace.json.gz`` is a real
+CPU-backend ``jax.profiler`` Chrome trace of a 20-step matmul+softmax
+loop (mined once, numbers frozen here), ``degraded/`` holds synthetic
+torn / truncated-json / empty variants.  Everything here is jax-free
+except the capture tests (which monkeypatch the profiler backend) and
+the smoke subprocess.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+from attackfl_tpu.ledger.compare import (
+    compare_records, regress_check, rolling_baseline,
+)
+from attackfl_tpu.ledger.record import derive_record
+from attackfl_tpu.profiler.capture import HotspotCapture
+from attackfl_tpu.profiler.cli import main as hotspots_main
+from attackfl_tpu.profiler.mine import (
+    HOST_BOUND_THRESHOLD,
+    compact_summary,
+    hotspots_from_events,
+    load_trace_events,
+    mine_profile_dir,
+    mine_trace,
+    op_category,
+)
+from attackfl_tpu.telemetry.counters import Counters
+from attackfl_tpu.telemetry.events import (
+    KINDS_BY_VERSION,
+    REQUIRED_FIELDS,
+    SCHEMA_VERSION,
+    validate_event,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "tests" / "data" / "profile_corpus"
+REAL = CORPUS / "real"
+REAL_TRACE = REAL / "real.trace.json.gz"
+
+
+def _write_trace(path: Path, ops, extra_rows=()) -> Path:
+    """Synthesize a Chrome-trace gz: ops = (name, module, ts, dur[, pid,
+    tid]) tuples in microseconds."""
+    rows = []
+    for op in ops:
+        name, module, ts, dur = op[:4]
+        pid = op[4] if len(op) > 4 else 1
+        tid = op[5] if len(op) > 5 else 2
+        rows.append({"ph": "X", "pid": pid, "tid": tid, "ts": ts,
+                     "dur": dur, "name": name,
+                     "args": {"hlo_op": name, "hlo_module": module}})
+    rows.extend(extra_rows)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": rows}, fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# op categorisation
+# ---------------------------------------------------------------------------
+
+def test_op_category_strips_hlo_suffix_and_buckets_by_priority():
+    assert op_category("dot.4") == "matmul"
+    assert op_category("convolution") == "matmul"
+    assert op_category("broadcast_divide_fusion.3") == "elementwise"
+    assert op_category("reduce_sum.7") == "reduction"
+    assert op_category("all-reduce.1") == "collective"
+    assert op_category("copy.2") == "copy"
+    assert op_category("transpose") == "copy"
+    # collective marks outrank token buckets (all-reduce contains
+    # 'reduce'), matmul outranks elementwise (loop_convolution_add)
+    assert op_category("loop_convolution_add_fusion") == "matmul"
+    # a bare fusion name carries no signal
+    assert op_category("fusion") == "other"
+    assert op_category("fusion.12") == "other"
+    # '.N' stripping must not eat real names
+    assert op_category("dot") == "matmul"
+    assert op_category("v1.2.3") == op_category("v1.2")
+
+
+# ---------------------------------------------------------------------------
+# trace loading: torn inputs are statuses, never exceptions
+# ---------------------------------------------------------------------------
+
+def test_load_trace_statuses_across_the_committed_corpus():
+    rows, status = load_trace_events(str(REAL_TRACE))
+    assert status == "ok" and rows
+    _, torn = load_trace_events(
+        str(CORPUS / "degraded" / "torn.trace.json.gz"))
+    assert torn == "torn"  # truncated gzip stream
+    _, bad = load_trace_events(
+        str(CORPUS / "degraded" / "badjson.trace.json.gz"))
+    assert bad == "torn"  # valid gzip, truncated JSON
+    _, empty = load_trace_events(
+        str(CORPUS / "degraded" / "empty.trace.json.gz"))
+    assert empty == "empty"
+    _, missing = load_trace_events(str(CORPUS / "nope.trace.json.gz"))
+    assert missing == "torn"
+
+
+# ---------------------------------------------------------------------------
+# golden attribution on the committed real trace
+# ---------------------------------------------------------------------------
+
+def test_golden_attribution_on_real_trace():
+    report = mine_profile_dir(str(REAL))
+    assert report["status"] == "ok"
+    assert (report["ok"], report["torn"], report["empty"]) == (1, 0, 0)
+    assert report["wall_us"] == 9196.783
+    assert report["device_busy_us"] == 8893.959
+    assert report["op_self_us"] == 8893.959
+    top = report["ops"][0]
+    assert top["name"] == "dot"
+    assert top["program"] == "jit_f"
+    assert top["category"] == "matmul"
+    assert top["count"] == 20
+    assert top["share"] == 0.7766
+    assert report["categories"]["matmul"]["share"] == 0.7766
+    assert report["categories"]["reduction"]["ops"] == 2
+    assert report["host_bound_fraction"] == 0.0329
+    assert report["classification"] == "device_bound"
+    # gap diagnosis: a tight device loop — gaps live in the <=10us bucket
+    hist = {bucket["le_us"]: bucket["count"]
+            for bucket in report["gap_histogram"]}
+    assert hist[10.0] == 95 and hist[100.0] == 4
+    assert hist[None] == 0
+
+
+def test_books_close_invariant_holds_on_real_trace():
+    report = mine_trace(str(REAL_TRACE))
+    books = report["books"]
+    assert books["close"] is True
+    assert report["op_self_us"] <= report["device_busy_us"] + 1.0
+    assert report["device_busy_us"] <= \
+        report["wall_us"] * report["lanes"] + 1.0
+
+
+def test_self_time_subtracts_nested_children():
+    """Containment: a 100us parent with a 60us child inside it self-times
+    40us; totals still books-close against the busy union."""
+    trace = _write_trace(
+        Path("/tmp/_hot_nested") / "n.trace.json.gz",
+        [("fusion_outer", "jit_m", 0.0, 100.0),
+         ("dot.1", "jit_m", 20.0, 60.0)])
+    report = mine_trace(str(trace))
+    by_name = {row["name"]: row for row in report["ops"]}
+    assert by_name["fusion_outer"]["total_us"] == 100.0
+    assert by_name["fusion_outer"]["self_us"] == 40.0
+    assert by_name["dot"]["self_us"] == 60.0
+    assert report["device_busy_us"] == 100.0  # union, not sum
+    assert report["op_self_us"] == 100.0
+    assert report["books"]["close"] is True
+
+
+def test_gap_histogram_flags_host_bound_dispatch():
+    """Three 100us ops separated by ~50ms dispatch gaps: the device is
+    idle almost the whole window -> host_bound past the 0.5 threshold,
+    gaps land in the right log buckets."""
+    trace = _write_trace(
+        Path("/tmp/_hot_gaps") / "g.trace.json.gz",
+        [("dot.1", "jit_m", 0.0, 100.0),
+         ("dot.2", "jit_m", 50_000.0, 100.0),
+         ("dot.3", "jit_m", 100_000.0, 100.0)])
+    report = mine_trace(str(trace))
+    assert report["host_bound_fraction"] > HOST_BOUND_THRESHOLD
+    assert report["classification"] == "host_bound"
+    hist = {bucket["le_us"]: bucket["count"]
+            for bucket in report["gap_histogram"]}
+    assert hist[100_000.0] == 2  # two ~49.9ms gaps
+    assert report["books"]["close"] is True
+
+
+def test_non_device_rows_are_ignored():
+    """Metadata and host-side rows (no args.hlo_op) never enter the
+    attribution."""
+    trace = _write_trace(
+        Path("/tmp/_hot_meta") / "m.trace.json.gz",
+        [("dot.1", "jit_m", 0.0, 50.0)],
+        extra_rows=[
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "host"}},
+            {"ph": "X", "pid": 9, "tid": 9, "ts": 0.0, "dur": 999.0,
+             "name": "TraceMe.host_callback", "args": {}},
+        ])
+    report = mine_trace(str(trace))
+    assert [row["name"] for row in report["ops"]] == ["dot"]
+    assert report["device_busy_us"] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# torn traces counted loudly across a directory
+# ---------------------------------------------------------------------------
+
+def test_mixed_corpus_counts_torn_and_empty_without_dropping():
+    report = mine_profile_dir(str(CORPUS))
+    assert report["traces"] == 4
+    assert (report["ok"], report["torn"], report["empty"]) == (1, 2, 1)
+    assert report["status"] == "ok"  # one usable window still attributes
+    statuses = {window["trace"]: window["status"]
+                for window in report["windows"]}
+    assert statuses["torn.trace.json.gz"] == "torn"
+    assert statuses["badjson.trace.json.gz"] == "torn"
+    assert statuses["empty.trace.json.gz"] == "empty"
+    assert statuses["real.trace.json.gz"] == "ok"
+    # attribution comes from the OK window alone
+    assert report["ops"][0]["name"] == "dot"
+
+
+def test_all_torn_corpus_reports_unusable_status():
+    report = mine_profile_dir(str(CORPUS / "degraded"))
+    assert report["status"] == "torn"
+    assert report["host_bound_fraction"] is None
+    report = mine_profile_dir("/tmp/_hot_does_not_exist")
+    assert report["status"] == "no_traces"
+
+
+# ---------------------------------------------------------------------------
+# event distillation -> the ledger block -> the cost-observatory join
+# ---------------------------------------------------------------------------
+
+def _hotspot_event(**over):
+    event = {
+        "kind": "hotspot", "status": "ok", "program": "sync",
+        "round_first": 2, "round_last": 3,
+        "wall_us": 2_000_000.0, "device_busy_us": 1_500_000.0,
+        "op_self_us": 1_400_000.0, "books_close": True,
+        "host_bound_fraction": 0.25, "classification": "device_bound",
+        "top_ops": [{"name": "convolution", "program": "jit_round_step",
+                     "category": "matmul", "self_us": 1_000_000.0,
+                     "share": 0.71},
+                    {"name": "reduce", "program": "jit_round_step",
+                     "category": "reduction", "self_us": 200_000.0,
+                     "share": 0.14}],
+        "category_shares": {"matmul": 0.71, "reduction": 0.14},
+    }
+    event.update(over)
+    return event
+
+
+def test_hotspots_from_events_distills_windows():
+    block = hotspots_from_events([
+        {"kind": "round", "round": 1},
+        _hotspot_event(),
+        _hotspot_event(status="torn", round_first=4, round_last=4),
+    ])
+    assert block["windows"] == 2
+    assert block["status_counts"] == {"ok": 1, "torn": 1}
+    assert block["host_bound_fraction"] == 0.25
+    assert block["classification"] == "device_bound"
+    assert block["books_close"] is True
+    assert block["top_ops"][0]["name"] == "convolution"
+    assert block["profiled_rounds"] == 2  # rounds 2..3
+    assert block["measured_round_device_s"] == 0.75  # 1.5s busy / 2
+    assert hotspots_from_events([{"kind": "round", "round": 1}]) is None
+
+
+def test_derive_record_joins_measured_against_predicted():
+    """A run with a hotspot window plus a ledger corpus of fingerprint
+    peers: the record's hotspots block carries the measured per-round
+    device seconds reconciled against the cost observatory's peer
+    prediction as a symmetric error factor."""
+    events = [
+        {"kind": "run_header", "run_id": "r1", "schema": SCHEMA_VERSION},
+        {"kind": "round", "round": 1, "ok": True, "broadcast": 1,
+         "seconds": 2.0},
+        {"kind": "round", "round": 2, "ok": True, "broadcast": 1,
+         "seconds": 2.0},
+        {"kind": "round", "round": 3, "ok": True, "broadcast": 1,
+         "seconds": 2.0},
+        _hotspot_event(),
+    ]
+    corpus = [{"record_id": f"peer{i}", "fingerprint": "fp1",
+               "schema_ok": True, "ok_rounds": 3,
+               "round_device_time": 1.5} for i in range(3)]
+    record = derive_record(events, fingerprint="fp1",
+                           ledger_records=corpus)
+    block = record["hotspots"]
+    assert block["measured_round_device_s"] == 0.75
+    assert block["prediction_method"] == "peer"
+    assert block["predicted_round_device_s"] == 1.5
+    # symmetric: max(p/a, a/p) = 1.5/0.75
+    assert block["hotspot_prediction_error_factor"] == 2.0
+
+
+def test_derive_record_without_corpus_leaves_prediction_null():
+    events = [
+        {"kind": "round", "round": 1, "ok": True, "broadcast": 1,
+         "seconds": 2.0},
+        _hotspot_event(),
+    ]
+    record = derive_record(events, fingerprint="fp1")
+    block = record["hotspots"]
+    assert block["predicted_round_device_s"] is None
+    assert block["hotspot_prediction_error_factor"] is None
+    # a run with no profiling window has no block at all
+    no_window = derive_record([{"kind": "round", "round": 1, "ok": True,
+                                "broadcast": 1, "seconds": 2.0}])
+    assert no_window["hotspots"] is None
+
+
+# ---------------------------------------------------------------------------
+# compare / rolling baseline / regress gates
+# ---------------------------------------------------------------------------
+
+def _record(hostbound, conv_share, *, rid="r", device_s=0.75):
+    return {
+        "record_id": rid, "fingerprint": "fp1", "schema_ok": True,
+        "ok_rounds": 3,
+        "hotspots": {
+            "windows": 1, "status_counts": {"ok": 1},
+            "host_bound_fraction": hostbound,
+            "classification": "device_bound", "books_close": True,
+            "measured_round_device_s": device_s,
+            "top_ops": [
+                {"name": "convolution", "share": conv_share},
+                {"name": "reduce", "share": round(1 - conv_share, 4)}],
+        },
+    }
+
+
+def test_compare_records_carries_hotspot_deltas():
+    result = compare_records(_record(0.2, 0.7), _record(0.45, 0.5))
+    hot = result["hotspots"]
+    assert hot["host_bound_fraction"]["delta"] == 0.25
+    assert hot["top_op_shares"]["convolution"]["delta"] == -0.2
+    assert hot["books_close"] == {"old": True, "new": True}
+    assert compare_records({}, {})["hotspots"] is None
+
+
+def test_rolling_baseline_pools_hostbound_peers():
+    peers = [_record(f, 0.7, rid=f"r{i}")
+             for i, f in enumerate([0.20, 0.24, 0.22])]
+    baseline = rolling_baseline(peers, _record(0.2, 0.7, rid="cand"))
+    hot = baseline["hotspots"]
+    assert hot["host_bound_fraction"] == 0.22  # median
+    assert sorted(hot["hostbound_peers"]) == [0.2, 0.22, 0.24]
+    assert hot["measured_round_device_s"] == 0.75
+    assert {row["name"] for row in hot["top_ops"]} == \
+        {"convolution", "reduce"}
+
+
+def test_regress_gate_fails_on_hostbound_rise_and_share_drift():
+    baseline = rolling_baseline(
+        [_record(f, 0.7, rid=f"r{i}")
+         for i, f in enumerate([0.20, 0.24, 0.22])],
+        _record(0.2, 0.7, rid="cand"))
+    ok = regress_check(baseline, _record(0.25, 0.68))
+    hot_violations = [v for v in ok["violations"]
+                      if v["check"].startswith("hotspots")]
+    assert hot_violations == []
+    # +0.28 host-bound rise past the 0.15 default (peer spread 0.04
+    # stays under it) -> gate closes
+    bad = regress_check(baseline, _record(0.50, 0.7))
+    checks = [v["check"] for v in bad["violations"]]
+    assert "hotspots:host_bound_fraction" in checks
+    # top-op share collapse (0.7 -> 0.4) on an op in both tables
+    drifted = regress_check(baseline, _record(0.22, 0.4))
+    checks = [v["check"] for v in drifted["violations"]]
+    assert "hotspots:op_share:convolution" in checks
+
+
+def test_regress_gate_floors_threshold_with_peer_spread():
+    """A baseline whose own peers wobble 0.25 cannot gate a 0.2 rise:
+    the spread floors the threshold (capped at hostbound_noise_cap)."""
+    noisy = rolling_baseline(
+        [_record(f, 0.7, rid=f"r{i}")
+         for i, f in enumerate([0.10, 0.35, 0.2])],
+        _record(0.2, 0.7, rid="cand"))
+    result = regress_check(noisy, _record(0.42, 0.7))
+    assert not any(v["check"] == "hotspots:host_bound_fraction"
+                   for v in result["violations"])
+
+
+# ---------------------------------------------------------------------------
+# the hotspots CLI: exit codes + golden render
+# ---------------------------------------------------------------------------
+
+def test_cli_show_golden_on_committed_corpus(capsys):
+    assert hotspots_main(["show", str(REAL)]) == 0
+    out = capsys.readouterr().out
+    assert "books close: True" in out
+    assert "host-bound fraction: 0.0329 -> device_bound" in out
+    assert "dot" in out and "matmul" in out
+
+
+def test_cli_show_json_round_trips(capsys):
+    assert hotspots_main(["show", str(REAL), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ops"][0]["share"] == 0.7766
+    assert report["books"]["close"] is True
+
+
+def test_cli_show_resolves_telemetry_dir(tmp_path, capsys):
+    """A telemetry dir containing profile/ resolves to the nested
+    tree."""
+    shutil.copytree(str(REAL), str(tmp_path / "profile"))
+    assert hotspots_main(["show", str(tmp_path)]) == 0
+
+
+def test_cli_show_fails_loudly_without_usable_windows(capsys):
+    assert hotspots_main(["show", "/tmp/_hot_does_not_exist"]) == 1
+    assert "no_traces" in capsys.readouterr().out
+    assert hotspots_main(["show", str(CORPUS / "degraded")]) == 1
+
+
+def test_cli_diff_self_passes_and_drift_fails(tmp_path, capsys):
+    assert hotspots_main(["diff", str(REAL), str(REAL)]) == 0
+    assert "ok: within thresholds" in capsys.readouterr().out
+    # a host-bound window vs the device-bound corpus: fraction rises
+    # ~0.0329 -> ~0.998 past the 0.15 default
+    _write_trace(tmp_path / "hb" / "g.trace.json.gz",
+                 [("dot.1", "jit_f", 0.0, 100.0),
+                  ("dot.2", "jit_f", 50_000.0, 100.0)])
+    assert hotspots_main(["diff", str(REAL), str(tmp_path / "hb")]) == 1
+    assert "DRIFT host_bound_fraction" in capsys.readouterr().out
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    assert hotspots_main(["diff", str(REAL)]) == 2
+    assert hotspots_main(["show", "a", "b"]) == 2
+    assert hotspots_main(["frobnicate"]) == 2
+    assert hotspots_main(["show", "--top", "many"]) == 2
+    # unminable inputs are usage-grade for diff, not drift
+    assert hotspots_main(
+        ["diff", str(REAL), "/tmp/_hot_does_not_exist"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# fail-open capture at the dispatch seam
+# ---------------------------------------------------------------------------
+
+class _EventSink:
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, kind, **fields):
+        self.rows.append({"kind": kind, **fields})
+
+
+class _Tele:
+    def __init__(self, base, enabled=True):
+        self.events = _EventSink()
+        self.counters = Counters()
+        self.enabled = enabled
+        self.base_dir = str(base)
+
+    def hotspot_events(self):
+        return [e for e in self.events.rows if e["kind"] == "hotspot"]
+
+
+def test_capture_degrades_on_unwritable_profile_dir(tmp_path, capsys):
+    """The profile path collides with a plain file -> makedirs raises;
+    the window degrades to one unavailable event + counter and is spent
+    (no retry storm), the run is untouched."""
+    (tmp_path / "profile").write_text("not a directory")
+    tele = _Tele(tmp_path)
+    capture = HotspotCapture(tele, (2, 3))
+    capture.maybe_start(2, program="sync")
+    assert capture.profiling is False
+    [event] = tele.hotspot_events()
+    assert event["status"] == "unavailable"
+    assert event["program"] == "sync"
+    assert (event["round_first"], event["round_last"]) == (2, 2)
+    assert "unwritable" in event["reason"]
+    assert tele.counters.get("hotspot_windows_unavailable") == 1
+    # spent: asking again neither starts nor re-emits
+    capture.maybe_start(3, program="sync")
+    assert capture.profiling is False
+    assert len(tele.hotspot_events()) == 1
+    capture.maybe_stop(99)  # no-op, never raises
+    capsys.readouterr()
+
+
+def test_capture_degrades_when_start_trace_raises(tmp_path, monkeypatch,
+                                                  capsys):
+    import jax
+
+    def boom(path):
+        raise RuntimeError("profiler backend unavailable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    tele = _Tele(tmp_path)
+    capture = HotspotCapture(tele, (1, 1))
+    capture.maybe_start(1, program="fused")
+    assert capture.profiling is False
+    [event] = tele.hotspot_events()
+    assert event["status"] == "unavailable"
+    assert "start_trace failed" in event["reason"]
+    assert tele.counters.get("hotspot_windows_unavailable") == 1
+    capsys.readouterr()
+
+
+def test_capture_mines_and_emits_ok_window(tmp_path, monkeypatch,
+                                           capsys):
+    """The full seam with a faked backend: stop_trace drops a real trace
+    artifact into the window's tree -> one schema-v14 hotspot event with
+    relative trace path, mined summary and true round coverage."""
+    import jax
+
+    profile = tmp_path / "profile"
+
+    def fake_stop():
+        target = profile / "plugins" / "profile" / "t1"
+        target.mkdir(parents=True)
+        shutil.copy(str(REAL_TRACE), str(target / "real.trace.json.gz"))
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda path: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+    tele = _Tele(tmp_path)
+    capture = HotspotCapture(tele, (2, 3))
+    capture.maybe_start(1, program="sync")
+    assert capture.profiling is False  # round 1 is outside the window
+    capture.maybe_start(2, program="sync")
+    assert capture.profiling is True
+    capture.maybe_stop(2)  # window end not reached -> stays open
+    assert capture.profiling is True
+    capture.maybe_stop(3)
+    assert capture.profiling is False
+    [event] = tele.hotspot_events()
+    assert event["status"] == "ok"
+    assert event["program"] == "sync"
+    # coverage runs to the last completed round, not the start round
+    assert (event["round_first"], event["round_last"]) == (2, 3)
+    assert event["trace"] == os.path.join(
+        "profile", "plugins", "profile", "t1", "real.trace.json.gz")
+    assert event["books_close"] is True
+    assert event["top_ops"][0]["name"] == "dot"
+    assert event["host_bound_fraction"] == 0.0329
+    assert tele.counters.get("hotspot_windows_ok") == 1
+    assert validate_event({"schema": SCHEMA_VERSION, "ts": 0.0,
+                           **event}) == []
+    assert "[hotspots] sync rounds 2-3" in capsys.readouterr().out
+
+
+def test_capture_counts_empty_window(tmp_path, monkeypatch, capsys):
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda path: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    tele = _Tele(tmp_path)
+    capture = HotspotCapture(tele, (1, 1))
+    capture.maybe_start(1, program="matrix")
+    capture.maybe_stop(force=True)
+    [event] = tele.hotspot_events()
+    assert event["status"] == "empty"
+    assert tele.counters.get("hotspot_windows_empty") == 1
+    capsys.readouterr()
+
+
+def test_capture_disabled_telemetry_is_inert(tmp_path):
+    tele = _Tele(tmp_path, enabled=False)
+    capture = HotspotCapture(tele, (1, 2))
+    assert capture.window is None
+    capture.maybe_start(1)
+    assert capture.profiling is False
+    assert tele.events.rows == []
+
+
+# ---------------------------------------------------------------------------
+# live surfacing: /hotspots route, the gauge, watch
+# ---------------------------------------------------------------------------
+
+def test_monitor_serves_hotspots_and_gauge(tmp_path, capsys):
+    import urllib.request
+
+    from attackfl_tpu import cli
+    from attackfl_tpu.telemetry import (
+        Counters, EventLog, NullTracer, Telemetry,
+    )
+    from attackfl_tpu.telemetry.monitor import RunMonitor
+
+    tele = Telemetry(EventLog(str(tmp_path / "events.jsonl")),
+                     NullTracer(), Counters(), True,
+                     base_dir=str(tmp_path))
+    monitor = RunMonitor(tele, port=0, poll_interval=3600)
+    monitor.start()
+    try:
+        monitor.run_started()
+        monitor.record_round({"round": 2, "broadcast": 2, "ok": True,
+                              "seconds": 0.1})
+        assert "attackfl_host_bound_fraction" not in \
+            monitor.metrics_text()
+        monitor.set_hotspots({"program": "sync", "round_first": 2,
+                              "round_last": 3,
+                              "host_bound_fraction": 0.2342,
+                              "classification": "device_bound",
+                              "books_close": True})
+        assert 'attackfl_host_bound_fraction{program="sync"} 0.2342' \
+            in monitor.metrics_text()
+        url = f"http://127.0.0.1:{monitor.port}"
+        with urllib.request.urlopen(url + "/hotspots", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["windows"]["sync"]["host_bound_fraction"] == 0.2342
+        assert cli.watch_main([url, "--once"]) == 0
+        assert "hostbound=0.234" in capsys.readouterr().out
+    finally:
+        monitor.stop()
+
+
+# ---------------------------------------------------------------------------
+# schema v14
+# ---------------------------------------------------------------------------
+
+def test_schema_v14_declares_hotspot_kind():
+    assert SCHEMA_VERSION == 14
+    assert "hotspot" in KINDS_BY_VERSION[14]
+    assert REQUIRED_FIELDS["hotspot"] == {"status": str}
+
+
+def test_committed_v14_corpus_validates_and_carries_the_window():
+    path = REPO / "tests" / "data" / "events.v14.jsonl"
+    events = [json.loads(line) for line in path.open()]
+    for event in events:
+        assert validate_event(event) == [], event["kind"]
+    hotspot = next(e for e in events if e["kind"] == "hotspot")
+    assert hotspot["schema"] == 14
+    assert hotspot["status"] == "ok"
+    assert hotspot["program"] == "sync"
+    assert hotspot["trace"].endswith(".trace.json.gz")
+    assert hotspot["books_close"] is True
+    assert hotspot["top_ops"][0]["category"] == "matmul"
+    assert 0.0 <= hotspot["host_bound_fraction"] <= 1.0
+
+
+def test_schema_v14_rejects_malformed_hotspots():
+    base = {"schema": 14, "ts": 0.0, "kind": "hotspot"}
+    assert any("status" in e for e in validate_event(base))
+    assert validate_event({**base, "status": "ok"}) == []
+    assert any("books_close" in e for e in validate_event(
+        {**base, "status": "ok", "books_close": "yes"}))
+    assert any("host_bound_fraction" in e for e in validate_event(
+        {**base, "status": "ok", "host_bound_fraction": "0.3"}))
+    assert any("top_ops" in e for e in validate_event(
+        {**base, "status": "ok", "top_ops": {}}))
+    assert any("round_first" in e for e in validate_event(
+        {**base, "status": "ok", "round_first": 1.5}))
+
+
+def test_compact_summary_feeds_valid_events():
+    summary = compact_summary(mine_trace(str(REAL_TRACE)))
+    event = {"schema": SCHEMA_VERSION, "ts": 0.0, "kind": "hotspot",
+             "status": "ok", **summary}
+    assert validate_event(event) == []
+
+
+# ---------------------------------------------------------------------------
+# the one-shot smoke gate: a REAL profiled run through the observatory
+# ---------------------------------------------------------------------------
+
+def test_hotspots_smoke_script():
+    """scripts/hotspots_smoke.sh — a real 3-round profiled CPU run:
+    the v14 hotspot event validates, `hotspots show` reproduces a
+    books-closing attribution from the written trace, diff-vs-self
+    passes the gate, and the ledger record carries the joined block."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    result = subprocess.run(
+        ["bash", str(REPO / "scripts" / "hotspots_smoke.sh")],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=560)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "hotspots smoke: OK" in result.stdout
+    assert "books close" in result.stdout
+
+
+if __name__ == "__main__":
+    sys.exit(subprocess.call(
+        [sys.executable, "-m", "pytest", __file__, "-q"]))
