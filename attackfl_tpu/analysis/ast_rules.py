@@ -6,17 +6,24 @@ Four rules over the package's Python sources:
   ``float(...)``, ``np.asarray``/``np.array``, ``device_get``) on the
   training hot path outside the audited allowlist.  Migrated from
   ``scripts/check_host_sync.py`` (ISSUE 3), which is now a shim over this
-  module.  The allowlist is *resolved against the live modules* at lint
-  time: an allowlisted qualified name that no longer exists (renamed,
-  deleted) is itself a finding, so the audited-transfer budget can't
-  silently drift from the code it audits.
+  module.  The linted file set is *discovered*, not hand-maintained
+  (ISSUE 20 satellite): every source under ``attackfl_tpu/`` must
+  classify against the TRACED_ONLY / HOST_SIDE prefix registries, and an
+  unclassified file is itself a finding.  The allowlist is likewise
+  *resolved against the live modules* at lint time: an allowlisted
+  qualified name that no longer exists (renamed, deleted) is itself a
+  finding, so the audited-transfer budget can't silently drift from the
+  code it audits.
 * ``donation-after-use`` — a buffer donated to a jitted program
   (``jax.jit(..., donate_argnums=...)``) is read again after the donating
   call.  Donated buffers are invalidated by dispatch; re-reading one is a
   runtime ``RuntimeError`` on real hardware and silent wrong-buffer reuse
-  at worst.  Only *literal* donate_argnums are tracked — conditional
-  donation (``() if cond else (1,)``, the engine's numerics-aware policy)
-  is a host-level decision the jaxpr auditor covers instead.
+  at worst.  Literal donate_argnums are tracked, and so is the
+  conditional-literal idiom (``(0,) if donate else ()``, the engine's
+  numerics-aware policy — ISSUE 20 satellite): an *unguarded* read after
+  a conditional donation is flagged, a read inside an ``if`` is assumed
+  correlated with the non-donating branch and exempt.  Computed argnums
+  (subscripts into donation_spec()) stay with the jaxpr auditor.
 * ``retrace-hazard`` — patterns that make a jitted program retrace after
   round 1: ``jax.jit`` inside a loop (a fresh program per iteration),
   Python scalar conversions (``float()``/``int()``) flowing into a
@@ -46,48 +53,99 @@ from attackfl_tpu.analysis.registry import AuditContext, register
 # ---------------------------------------------------------------------------
 
 REPO = Path(__file__).resolve().parent.parent.parent
-TRAINING = REPO / "attackfl_tpu" / "training"
-# the numerics engine (ISSUE 4) is held to the same standard: metric
-# compute fns are traced-only, and exactly one drain transfer is audited
-NUMERICS_FILES = (
-    REPO / "attackfl_tpu" / "ops" / "metrics.py",
-    REPO / "attackfl_tpu" / "telemetry" / "numerics.py",
-)
-# the fault-injection harness (ISSUE 6): the device-side mask builders
-# compile the plan into the round program and must be traced-only (NO
-# allowlisted functions by design — injection may never add a host sync
-# to the round hot path); the host injector only touches host values
-FAULTS_FILES = (
-    REPO / "attackfl_tpu" / "faults" / "plan.py",
-    REPO / "attackfl_tpu" / "faults" / "inject.py",
-)
-# the run service (ISSUE 8): pure host-side orchestration over the
-# engine's audited paths — it must never materialize device values
-# itself (NO allowlisted functions by design; every sync a worker needs
-# already lives behind the engine's audited resolve points)
-SERVICE_DIR = REPO / "attackfl_tpu" / "service"
-# the scenario matrix (ISSUE 9): grid logic + the batched round-body
-# builders are traced-only (NO allowlist by design — the sweep's single
-# audited materialization lives in training/matrix_exec.py, which the
-# TRAINING glob already covers with its own allowlist entries below)
-MATRIX_DIR = REPO / "attackfl_tpu" / "matrix"
-# the cost observatory (ISSUE 11): capture reads XLA analysis objects
-# and the estimate/report halves do pure JSON arithmetic — neither may
-# ever materialize a device value (NO allowlist by design; profiling a
-# program is lower+compile, not dispatch)
-COSTMODEL_DIR = REPO / "attackfl_tpu" / "costmodel"
-# the shard_map execution layer (ISSUE 12): mapped bodies + collective
-# aggregation are traced-only (NO allowlist by design — a collective is
-# device-device, never device-host; mesh.py itself is host-side
-# placement plumbing and stays outside this lint, like the engine's
-# non-hot-path modules)
-PARALLEL_FILES = (REPO / "attackfl_tpu" / "parallel" / "shard.py",)
-# the hotspot observatory (ISSUE 19): the capture half wraps
-# jax.profiler start/stop around dispatch seams (never a sync), and the
-# mining/CLI halves are stdlib-only JSON arithmetic — NO allowlist by
-# design; numeric coercion in profiler/ uses the costmodel's `+ 0.0`
-# idiom, never float()
-PROFILER_DIR = REPO / "attackfl_tpu" / "profiler"
+PACKAGE = REPO / "attackfl_tpu"
+
+# --- host-sync coverage registry (ISSUE 20 satellite) ----------------------
+# Every .py under attackfl_tpu/ is DISCOVERED (rglob) and must classify
+# into exactly one of two prefix registries.  Keys are package-relative
+# POSIX paths; a trailing "/" marks a directory prefix; the LONGEST
+# matching prefix wins across both tables, so a file-level override
+# (telemetry/numerics.py) beats its directory's default (telemetry/).
+#
+# TRACED_ONLY files are linted: any sync shape outside ALLOWED_FUNCTIONS
+# is a finding.  HOST_SIDE files are exempt, each carrying the reason the
+# exemption is sound.  A discovered file matching NEITHER registry is
+# itself a finding — a new package can never silently escape the lint
+# (the hand-maintained per-PR file lists this replaces grew one package
+# behind the tree more than once between ISSUEs 3 and 19).
+TRACED_ONLY: dict[str, str] = {
+    "__init__.py": "top-level re-exports — import-time code may never "
+                   "materialize a device value",
+    "__main__.py": "python -m entry stub (delegates to the CLI)",
+    "registry.py": "name->constructor tables read at program-build time",
+    # the round hot path (ISSUE 3): every deliberate materialization is
+    # an ALLOWED_FUNCTIONS resolve point below
+    "training/": "round builders, executors and the engine hot path — "
+                 "deliberate materializations are audited resolve points",
+    "models/": "model init/apply run under trace",
+    # ISSUE 6: device-side mask builders compile the plan into the round
+    # program; the host injector only touches host values
+    "faults/": "fault plans compile into the round program; NO allowlist "
+               "by design — injection may never add a hot-path sync",
+    # ISSUE 8: pure host orchestration over the engine's audited paths
+    "service/": "host orchestration that must never materialize device "
+                "values itself (every needed sync lives behind the "
+                "engine's audited resolve points); NO allowlist by design",
+    # ISSUE 9: the sweep's single materialization lives in
+    # training/matrix_exec.py (covered by training/ above)
+    "matrix/": "grid logic + batched round-body builders are traced-only; "
+               "NO allowlist by design",
+    # ISSUE 11: profiling a program is lower+compile, not dispatch
+    "costmodel/": "capture reads XLA analysis objects, estimate/report do "
+                  "JSON arithmetic; NO allowlist by design",
+    # ISSUE 19: numeric coercion in profiler/ uses the `+ 0.0` idiom
+    "profiler/": "profiler start/stop seams + stdlib JSON trace mining; "
+                 "NO allowlist by design",
+    # ISSUE 20: the auditor holds itself to its own standard
+    "analysis/": "static passes, tracing and lowering never block on a "
+                 "device value; NO allowlist by design",
+    # ISSUE 12: a collective is device-device, never device-host
+    "parallel/shard.py": "mapped bodies + collective aggregation; NO "
+                         "allowlist by design",
+    "parallel/__init__.py": "re-export stub",
+    "ops/__init__.py": "re-export stub",
+    # ISSUE 4: the single audited drain lives in telemetry/numerics.py
+    "ops/metrics.py": "numerics metric compute fns are traced-only; NO "
+                      "allowlist by design",
+    "ops/aggregators.py": "defense aggregation chains run under trace",
+    "ops/attacks.py": "attack templates run under trace",
+    "ops/pytree.py": "pytree flatten/mask helpers used under jit",
+    "ops/fused_step.py": "the fused Pallas executor; run_epoch's float() "
+                         "on host config scalars at kernel-build time is "
+                         "allowlisted",
+    "telemetry/numerics.py": "traced metric ring buffer; "
+                             "NumericsDrainer.drain is the subsystem's "
+                             "single audited device->host transfer",
+}
+HOST_SIDE: dict[str, str] = {
+    "cli.py": "CLI entry point — parses argv and Prometheus text, host "
+              "strings only",
+    "config.py": "config parsing coerces JSON/env host scalars (float()) "
+                 "before any device program exists",
+    "data/": "dataset synthesis/partitioning — host numpy producing the "
+             "arrays rounds consume",
+    "eval/": "validation resolve points: Validation.test/resolve_async "
+             "are the designed synchronous reads, one per round/chunk, "
+             "off the hot path",
+    "ledger/": "run-ledger JSON I/O over already-resolved host values",
+    "ops/defenses.py": "host-side statistical defense halves "
+                       "(gmm/dbscan/fltracer) reached only through the "
+                       "engine's allowlisted resolve points",
+    "ops/stats.py": "numpy statistical kernels (PCA/GMM/DBSCAN) backing "
+                    "the host defense halves — pure host math",
+    "parallel/mesh.py": "host<->device placement plumbing; "
+                        "gather_to_host IS the designated mesh read",
+    "scheduler/": "job admission/pricing over resolved telemetry JSON — "
+                  "float() on host scalars",
+    "science/": "outcome analytics over the ledger's resolved host "
+                "values",
+    "telemetry/": "host-side observability consuming values the audited "
+                  "drains already materialized (numerics.py overridden "
+                  "to traced-only above)",
+    "utils/": "host utilities; checkpoint.host_state is the audited "
+              "device->host gather, called only from the engine's "
+              "allowlisted _save_checkpoint",
+}
 
 # Call shapes that materialize device values on host.
 SYNC_ATTRS = {"block_until_ready", "device_get"}
@@ -145,6 +203,12 @@ ALLOWED_FUNCTIONS: dict[str, set[str]] = {
         "MatrixRun._resolve_chunk",
         "MatrixRun._min_completed",
     },
+    #   - fused_step.py run_epoch: float() on host config scalars
+    #     (lr/clip/dropout rates) partial'd into the Pallas kernel at
+    #     build time — Python numbers from the config, never device values
+    "fused_step.py": {
+        "run_epoch",
+    },
 }
 
 # basename -> live module the allowlist entries must resolve against.
@@ -156,6 +220,7 @@ ALLOWLIST_MODULES: dict[str, str] = {
     "round.py": "attackfl_tpu.training.round",
     "numerics.py": "attackfl_tpu.telemetry.numerics",
     "matrix_exec.py": "attackfl_tpu.training.matrix_exec",
+    "fused_step.py": "attackfl_tpu.ops.fused_step",
 }
 
 HOST_SYNC_HINT = (
@@ -266,13 +331,52 @@ def resolve_host_sync_allowlist() -> list[Finding]:
     return findings
 
 
+def classify_host_sync(rel: str) -> tuple[str, str] | None:
+    """``("traced-only" | "host-side", reason)`` for a package-relative
+    POSIX path, or None when the coverage registry does not know the file.
+    Longest matching prefix wins across both registries."""
+    best: tuple[int, str, str] | None = None
+    for kind, table in (("traced-only", TRACED_ONLY),
+                        ("host-side", HOST_SIDE)):
+        for prefix, reason in table.items():
+            if rel == prefix or (prefix.endswith("/")
+                                 and rel.startswith(prefix)):
+                if best is None or len(prefix) > best[0]:
+                    best = (len(prefix), kind, reason)
+    return (best[1], best[2]) if best is not None else None
+
+
+def host_sync_coverage(package: Path = PACKAGE,
+                       root: Path = REPO
+                       ) -> tuple[list[Path], list[Finding]]:
+    """Discovery: every ``*.py`` under the package, classified against the
+    coverage registry.  Returns ``(traced-only files to lint, findings)``
+    where each unclassified file is a finding — new code fails the audit
+    until someone decides which side of the sync contract it lives on."""
+    traced: list[Path] = []
+    findings: list[Finding] = []
+    here = relativize(Path(__file__), root)
+    for path in sorted(package.rglob("*.py")):
+        rel = path.relative_to(package).as_posix()
+        cls = classify_host_sync(rel)
+        if cls is None:
+            findings.append(Finding(
+                rule="host-sync", file=here, line=0,
+                message=f"source file {package.name}/{rel} is not "
+                        "classified in the host-sync coverage registry — "
+                        "it would silently escape the lint",
+                hint="add the file (or its package) to TRACED_ONLY if its "
+                     "code runs under trace / must stay sync-free, or to "
+                     "HOST_SIDE with the reason the exemption is sound"))
+        elif cls[0] == "traced-only":
+            traced.append(path)
+    return traced, findings
+
+
 def host_sync_files() -> list[Path]:
-    return (sorted(TRAINING.glob("*.py")) + list(NUMERICS_FILES)
-            + list(FAULTS_FILES) + sorted(SERVICE_DIR.glob("*.py"))
-            + sorted(MATRIX_DIR.glob("*.py"))
-            + sorted(COSTMODEL_DIR.glob("*.py"))
-            + list(PARALLEL_FILES)
-            + sorted(PROFILER_DIR.glob("*.py")))
+    """The linted (traced-only) file set — now derived from discovery, not
+    hand-maintained lists (ISSUE 20 satellite)."""
+    return host_sync_coverage()[0]
 
 
 @register(
@@ -284,7 +388,9 @@ def host_sync_files() -> list[Path]:
 )
 def _host_sync_rule(ctx: AuditContext) -> list[Finding]:
     findings = resolve_host_sync_allowlist()
-    for path in host_sync_files():
+    traced, coverage = host_sync_coverage(ctx.package, ctx.root)
+    findings.extend(coverage)
+    for path in traced:
         findings.extend(host_sync_findings(path, ctx.tree(path), ctx.root))
     return findings
 
@@ -310,10 +416,12 @@ def host_sync_main(argv: list[str] | None = None) -> int:
     import sys
 
     args = list(sys.argv[1:] if argv is None else argv)
-    files = [Path(a) for a in args] if args else host_sync_files()
+    files = [Path(a) for a in args]
     violations: list[str] = []
-    if not args:  # full-tree runs also verify the allowlist is live
+    if not args:  # full-tree runs also verify allowlist + coverage
+        files, coverage = host_sync_coverage()
         violations.extend(f.format() for f in resolve_host_sync_allowlist())
+        violations.extend(f.format() for f in coverage)
     for path in files:
         if not path.exists():
             print(f"error: no such file {path}", file=sys.stderr)
@@ -370,6 +478,29 @@ def _literal_argnums(node: ast.AST | None,
     return None
 
 
+def _argnums_spec(node: ast.AST | None,
+                  consts: dict[str, tuple[int, ...]] | None = None
+                  ) -> tuple[tuple[int, ...], bool] | None:
+    """``(argnums, conditional)`` for a donate_argnums expression.
+
+    A plain literal is ``(argnums, False)``.  A conditional literal pair —
+    ``(0,) if donate else ()``, the engine/matrix numerics-aware donation
+    policy — is ``(union of both arms, True)``: the donation *may* happen,
+    so an unguarded later read of the buffer is a hazard in whichever
+    configuration donates.  Anything else (computed arms, subscripts into
+    donation_spec()) returns None — the jaxpr auditor covers the actual
+    aliasing there."""
+    lits = _literal_argnums(node, consts)
+    if lits is not None:
+        return lits, False
+    if isinstance(node, ast.IfExp):
+        body = _literal_argnums(node.body, consts)
+        orelse = _literal_argnums(node.orelse, consts)
+        if body is not None and orelse is not None:
+            return tuple(sorted(set(body) | set(orelse))), True
+    return None
+
+
 def _module_const_argnums(tree: ast.Module) -> dict[str, tuple[int, ...]]:
     """Top-level ``NAME = <int or tuple-of-int literal>`` bindings, so a
     donation/static policy named as a module constant stays trackable."""
@@ -419,30 +550,32 @@ class _ScopeWalker(ast.NodeVisitor):
 
 
 class _DonatingDefs(_ScopeWalker):
-    """Pass 1: names bound to ``jax.jit(..., donate_argnums=<literal>)``.
+    """Pass 1: names bound to ``jax.jit(..., donate_argnums=<literal or
+    conditional-literal>)``.
 
-    Records ``(scope, dotted_target) -> argnums``; ``self.x`` targets are
-    visible module-wide, bare names only within their defining scope (and
-    nested closures) — so a local ``fn`` in one method can't shadow-track
-    an unrelated ``fn`` in another.
+    Records ``(scope, dotted_target) -> (argnums, conditional)``;
+    ``self.x`` targets are visible module-wide, bare names only within
+    their defining scope (and nested closures) — so a local ``fn`` in one
+    method can't shadow-track an unrelated ``fn`` in another.
     """
 
     def __init__(self, consts: dict[str, tuple[int, ...]] | None = None):
         super().__init__()
         self.consts = consts or {}
-        self.defs: dict[str, tuple[str, tuple[int, ...]]] = {}
+        self.defs: dict[str, tuple[str, tuple[int, ...], bool]] = {}
 
     def visit_Assign(self, node: ast.Assign) -> None:
         call = _jit_call(node.value)
         if call is not None:
-            argnums = _literal_argnums(_jit_kwarg(call, "donate_argnums"),
-                                       self.consts)
-            if argnums:
+            spec = _argnums_spec(_jit_kwarg(call, "donate_argnums"),
+                                 self.consts)
+            if spec is not None and spec[0]:
+                argnums, conditional = spec
                 for target in node.targets:
                     name = _dotted(target)
                     if name:
                         scope = "" if name.startswith("self.") else self.scope()
-                        self.defs[name] = (scope, argnums)
+                        self.defs[name] = (scope, argnums, conditional)
         self.generic_visit(node)
 
 
@@ -450,45 +583,46 @@ class _DonationUseScanner(_ScopeWalker):
     """Pass 2: calls of donating callables, then later loads of the
     donated argument names within the same function."""
 
-    def __init__(self, defs: dict[str, tuple[str, tuple[int, ...]]],
+    def __init__(self, defs: dict[str, tuple[str, tuple[int, ...], bool]],
                  consts: dict[str, tuple[int, ...]] | None = None):
         super().__init__()
         self.defs = defs
         self.consts = consts or {}
-        self.hits: list[tuple[int, str, str, int]] = []
-        # (use_line, donated_name, callee, call_line)
+        self.hits: list[tuple[int, str, str, int, bool]] = []
+        # (use_line, donated_name, callee, call_line, conditional)
 
-    def _donating_call(self, call: ast.Call) -> tuple[str, tuple[int, ...]] | None:
+    def _donating_call(self, call: ast.Call
+                       ) -> tuple[str, tuple[int, ...], bool] | None:
         # direct form: jax.jit(f, donate_argnums=...)(args)
         inner = _jit_call(call.func)
         if inner is not None:
-            argnums = _literal_argnums(_jit_kwarg(inner, "donate_argnums"),
-                                       self.consts)
-            if argnums:
-                return ("jax.jit(...)", argnums)
+            spec = _argnums_spec(_jit_kwarg(inner, "donate_argnums"),
+                                 self.consts)
+            if spec is not None and spec[0]:
+                return ("jax.jit(...)",) + spec
         name = _dotted(call.func)
         if name is None:
             return None
         rec = self.defs.get(name)
         if rec is None:
             return None
-        def_scope, argnums = rec
+        def_scope, argnums, conditional = rec
         scope = self.scope()
         if def_scope and not (scope == def_scope
                               or scope.startswith(def_scope + ".")):
             return None  # a different function's local name
-        return (name, argnums)
+        return (name, argnums, conditional)
 
     def _function_scope(self, fn_node: ast.AST) -> None:
         """Analyze one function body: every donating call's donated names
         vs. subsequent loads/stores of those names."""
-        calls: list[tuple[ast.Call, str, list[str]]] = []
+        calls: list[tuple[ast.Call, str, list[str], bool]] = []
         for node in ast.walk(fn_node):
             if isinstance(node, ast.Call):
                 rec = self._donating_call(node)
                 if rec is None:
                     continue
-                callee, argnums = rec
+                callee, argnums, conditional = rec
                 donated = []
                 for i in argnums:
                     if i < len(node.args):
@@ -496,14 +630,24 @@ class _DonationUseScanner(_ScopeWalker):
                         if name:
                             donated.append(name)
                 if donated:
-                    calls.append((node, callee, donated))
+                    calls.append((node, callee, donated, conditional))
         if not calls:
             return
+        # reads guarded by an `if` are exempt for CONDITIONAL donations:
+        # the donation decision is host-level, and a guarded read is
+        # assumed correlated with the non-donating branch (the engine's
+        # `if self._numerics is not None:` idiom); an UNguarded read is
+        # wrong in whichever configuration donates
+        guarded: set[int] = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.If):
+                for sub in node.body + node.orelse:
+                    guarded.update(id(n) for n in ast.walk(sub))
         # name -> store lines across the function body (a rebind after the
         # donating call makes subsequent loads refer to the new buffer)
         stores: dict[str, list[int]] = {}
         inside_call: dict[int, set[int]] = {}
-        for call, _, _ in calls:
+        for call, _, _, _ in calls:
             inside_call.setdefault(id(call), set()).update(
                 id(n) for n in ast.walk(call))
         for node in ast.walk(fn_node):
@@ -513,7 +657,7 @@ class _DonationUseScanner(_ScopeWalker):
                 stores.setdefault(name, []).append(node.lineno)
         # loads are re-walked per call with node identity so arguments of
         # the donating call itself (which may span lines) are excluded
-        for call, callee, donated in calls:
+        for call, callee, donated, conditional in calls:
             call_ids = inside_call[id(call)]
             end = getattr(call, "end_lineno", call.lineno)
             for name in donated:
@@ -531,7 +675,10 @@ class _DonationUseScanner(_ScopeWalker):
                         continue
                     if first_rebind is not None and node.lineno > first_rebind:
                         continue
-                    self.hits.append((node.lineno, name, callee, call.lineno))
+                    if conditional and id(node) in guarded:
+                        continue
+                    self.hits.append((node.lineno, name, callee,
+                                      call.lineno, conditional))
                     break  # one finding per (call, name) is enough
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -555,11 +702,17 @@ def donation_after_use_findings(path: Path, tree: ast.Module | None = None,
     rel = relativize(path, root)
     return [
         Finding(rule="donation-after-use", file=rel, line=use_line,
-                message=f"`{name}` is read after being donated to "
-                        f"{callee} at line {call_line} — the donated "
-                        "buffer is invalidated by that dispatch",
+                message=(f"`{name}` is read after being conditionally "
+                         f"donated to {callee} at line {call_line} — the "
+                         "read is unguarded, so whichever configuration "
+                         "donates invalidates this buffer before it"
+                         if conditional else
+                         f"`{name}` is read after being donated to "
+                         f"{callee} at line {call_line} — the donated "
+                         "buffer is invalidated by that dispatch"),
                 hint=DONATION_HINT)
-        for use_line, name, callee, call_line in sorted(scanner.hits)
+        for use_line, name, callee, call_line, conditional
+        in sorted(scanner.hits)
     ]
 
 

@@ -10,6 +10,13 @@ golden format corpus).  Exit 0 when the tree is clean, 1 otherwise.
 ``--retrace`` additionally runs the dynamic retrace guard (executes a few
 CPU rounds per executor — seconds of compile, so opt-in; tier-1 exercises
 the guard through tests/test_analysis.py instead).
+
+The transform-safety auditor (ISSUE 20) runs by default whenever programs
+do: grad + double-backward programs of the post-defense damage objective
+(sync + fused per representative defense, mesh collective duals included)
+and the per-defense differentiability dataflow table.  ``--grad`` states
+the intent explicitly; ``--skip-grad`` drops it for time-budgeted
+harnesses, mirroring ``--skip-sharded``.
 """
 
 from __future__ import annotations
@@ -23,17 +30,24 @@ from attackfl_tpu.analysis.findings import Finding, sort_findings
 from attackfl_tpu.analysis.registry import (
     AuditContext, describe_rules, run_rules)
 
-REPORT_SCHEMA = 1
+REPORT_SCHEMA = 2
 
 
 def build_report(skip_programs: bool = False, retrace: bool = False,
                  rule_ids: list[str] | None = None,
-                 skip_sharded: bool = False) -> dict[str, Any]:
-    """Run the selected passes and assemble the audit report."""
+                 skip_sharded: bool = False,
+                 grad: bool | None = None) -> dict[str, Any]:
+    """Run the selected passes and assemble the audit report.  ``grad``
+    defaults to following the program audit (on unless
+    ``skip_programs``); pass True/False to force it either way."""
     ctx = AuditContext()
     findings: list[Finding] = run_rules(ctx, rule_ids)
     programs: list[dict[str, Any]] = []
+    grad_programs: list[dict[str, Any]] = []
+    dataflow_table: list[dict[str, Any]] = []
     budget: dict[str, Any] = {}
+    if grad is None:
+        grad = not skip_programs
     if not skip_programs:
         from attackfl_tpu.analysis import program_audit
 
@@ -52,6 +66,21 @@ def build_report(skip_programs: bool = False, retrace: bool = False,
         programs = [r.to_dict() for r in reports]
         findings.extend(program_audit.reports_to_findings(reports))
         budget = program_audit.transfer_budget()
+    if grad:
+        # transform-safety auditor (ISSUE 20): grad + double-backward
+        # programs (first-order lowered with donation checked; second-
+        # order and mesh-collective audits are jaxpr-only, so this whole
+        # section fits tier-1 even with --skip-sharded)
+        from attackfl_tpu.analysis import dataflow, grad_audit
+        from attackfl_tpu.analysis import program_audit as pa
+
+        greports = (grad_audit.audit_grad_programs()
+                    + grad_audit.audit_grad_collectives())
+        grad_programs = [r.to_dict() for r in greports]
+        findings.extend(pa.reports_to_findings(greports, rule="grad-audit"))
+        dreports = dataflow.defense_dataflow_reports()
+        dataflow_table = [r.to_dict() for r in dreports]
+        findings.extend(dataflow.defense_findings(dreports))
     if retrace:
         from attackfl_tpu.analysis.retrace import guard_findings
 
@@ -63,9 +92,23 @@ def build_report(skip_programs: bool = False, retrace: bool = False,
         "rules": describe_rules(),
         "findings": [f.to_dict() for f in findings],
         "programs": programs,
+        "grad_programs": grad_programs,
+        "dataflow": dataflow_table,
         "transfer_budget": budget,
         "ok": not findings,
     }
+
+
+def _format_program(p: dict[str, Any], prefix: str = "program") -> str:
+    status = "OK" if p["ok"] else "FAIL"
+    collectives = p.get("collectives") or []
+    return (
+        f"{prefix} {p['name']} [{p['executor']}]: {status} — "
+        f"{p['eqns']} eqns, donated {p['donated_leaves']} leaf(s), "
+        f"aliased {p['aliased_leaves']}/{p['expected_aliases']} "
+        f"expected, forbidden={p['forbidden_primitives'] or 'none'}, "
+        f"collectives={','.join(collectives) or 'none'}, "
+        f"f64={p['f64_outputs']}")
 
 
 def format_report(report: dict[str, Any]) -> str:
@@ -73,15 +116,17 @@ def format_report(report: dict[str, Any]) -> str:
     for f in report["findings"]:
         lines.append(Finding(**f).format())
     for p in report["programs"]:
-        status = "OK" if p["ok"] else "FAIL"
-        collectives = p.get("collectives") or []
+        lines.append(_format_program(p))
+    for p in report.get("grad_programs") or []:
+        lines.append(_format_program(p, prefix="grad program"))
+    for d in report.get("dataflow") or []:
+        cliffs = ",".join(sorted({c["primitive"] for c in d["cliffs"]}))
         lines.append(
-            f"program {p['name']} [{p['executor']}]: {status} — "
-            f"{p['eqns']} eqns, donated {p['donated_leaves']} leaf(s), "
-            f"aliased {p['aliased_leaves']}/{p['expected_aliases']} "
-            f"expected, forbidden={p['forbidden_primitives'] or 'none'}, "
-            f"collectives={','.join(collectives) or 'none'}, "
-            f"f64={p['f64_outputs']}")
+            f"dataflow {d['name']}: {d['verdict']} — reachability "
+            f"{d['reachability']:.3f} ({d['live_eqns']}/"
+            f"{d['touched_eqns']} path eqns), "
+            f"piecewise={','.join(d['piecewise']) or 'none'}, "
+            f"cliffs={cliffs or 'none'}")
     budget = report.get("transfer_budget") or {}
     if budget:
         lines.append(
@@ -92,6 +137,8 @@ def format_report(report: dict[str, Any]) -> str:
     lines.append(
         f"audit: {len(report['rules'])} rule(s), "
         f"{len(report['programs'])} program(s), "
+        f"{len(report.get('grad_programs') or [])} grad program(s), "
+        f"{len(report.get('dataflow') or [])} dataflow verdict(s), "
         f"{n} finding(s) — {'OK' if report['ok'] else 'FAIL'}")
     return "\n".join(lines)
 
@@ -114,6 +161,17 @@ def audit_main(argv: list[str] | None = None) -> int:
                         help="skip the mesh-native (shard_map) program "
                              "audits — their donation check COMPILES the "
                              "sharded programs (minutes on a small box)")
+    parser.add_argument("--grad", action="store_true",
+                        help="run the transform-safety auditor (grad + "
+                             "double-backward damage-objective programs "
+                             "and the per-defense differentiability "
+                             "table) — on by default whenever programs "
+                             "are audited; this flag forces it even "
+                             "with --skip-programs")
+    parser.add_argument("--skip-grad", action="store_true",
+                        help="skip the transform-safety auditor "
+                             "(time-budgeted harnesses, mirroring "
+                             "--skip-sharded)")
     parser.add_argument("--rules", nargs="*", default=None, metavar="RULE",
                         help="run only these rule ids (default: all)")
     parser.add_argument("--list-rules", action="store_true",
@@ -124,9 +182,12 @@ def audit_main(argv: list[str] | None = None) -> int:
         for rule in describe_rules():
             print(f"{rule['id']}: {rule['description']}")
         return 0
+    if args.grad and args.skip_grad:
+        parser.error("--grad and --skip-grad are mutually exclusive")
+    grad = True if args.grad else (False if args.skip_grad else None)
     report = build_report(skip_programs=args.skip_programs,
                           retrace=args.retrace, rule_ids=args.rules,
-                          skip_sharded=args.skip_sharded)
+                          skip_sharded=args.skip_sharded, grad=grad)
     if args.json:
         print(json.dumps(report, indent=2))
     else:

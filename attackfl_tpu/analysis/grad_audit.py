@@ -1,0 +1,243 @@
+"""Grad-program audit: the round's invariants survive ``jax.grad``
+(ISSUE 20 tentpole, part 1).
+
+The ROADMAP's learned-attack work differentiates a scalar post-defense
+damage objective through the whole round — local training, the attack
+templates, aggregation, the defense — and the resulting grad (and
+double-backward grad-of-grad-norm) program must keep every contract the
+forward programs pass under :mod:`attackfl_tpu.analysis.program_audit`:
+
+* **sync-freedom** — AD must not smuggle a callback/infeed into the
+  cotangent program (a custom_vjp backed by ``pure_callback`` would);
+* **dtype discipline** — no f64/complex cotangent promotion;
+* **donation** — the perturbation argument is donated to its own
+  gradient: ``grad(objective)`` returns the perturbation's exact tree,
+  so every donated leaf must alias 1:1 in the lowered StableHLO (this is
+  the buffer reuse the learned-attack ascent loop will live on);
+* **collectives under the mesh** — AD *transposes* collectives
+  (psum<->all_gather duals), so the grad program gets its own expected
+  table: the ``grad`` column of :data:`~attackfl_tpu.analysis.
+  program_audit.EXPECTED_COLLECTIVES`, derived in
+  :func:`attackfl_tpu.parallel.shard.grad_collectives`.
+
+The objectives come from the engine's :meth:`Simulator.damage_objective`
+audit seam (sync round->aggregate and the fused 2-round scan chunk —
+grad through local Adam training included).  First-order grads get the
+full audit (trace + lower, donation aliasing checked); double-backward
+programs are audited at the jaxpr level (tracing proves
+differentiability twice over; lowering them would double the audit's
+compile bill for no new invariant).  The mesh collective audit is
+jaxpr-only too — collectives appear at trace time, no compile needed —
+so it runs even under ``--skip-sharded`` budgets.
+
+Nothing in this module executes a program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from attackfl_tpu.analysis.program_audit import (
+    EXPECTED_COLLECTIVES,
+    ProgramReport,
+    audit_program,
+    collective_primitives,
+    forbidden_primitives,
+    walk_jaxpr,
+    wide_dtype_outputs,
+)
+from attackfl_tpu.analysis.registry import register_info
+
+# Representative defense triad (ISSUE 20 acceptance): a psum/mean
+# defense, an order-statistic defense, an anchor/trust defense.  The
+# slow full-grid test widens this to every mode.
+GRAD_MODES = ("fedavg", "median", "FLTrust")
+
+GRAD_AUDIT_HINT = (
+    "the grad/double-backward program broke a round invariant — look for "
+    "a custom_vjp with host callbacks, an f64 cotangent promotion, or a "
+    "collective AD transposed outside the `grad` column of "
+    "EXPECTED_COLLECTIVES")
+
+register_info(
+    "grad-audit",
+    "jax.grad and grad-of-grad-norm of the post-defense damage objective "
+    "(sync + fused, per representative defense) stay sync-free and "
+    "f64-free, donate the perturbation 1:1 into its gradient, and under "
+    "the mesh carry exactly the transposed collective set",
+    GRAD_AUDIT_HINT,
+)
+
+
+def _jit_donating(fn: Callable, donate: tuple[int, ...]):
+    """One audit-time ``jax.jit`` per grad program.  These jits exist to
+    be ``.lower()``'d exactly once for the donation-aliasing check —
+    nothing dispatches them — so the per-call program cache the
+    retrace-hazard rule protects does not apply here (and the rule sees
+    no jit-in-loop because this wrapper owns the call site)."""
+    import jax
+
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def double_backward(objective: Callable) -> Callable:
+    """``grad`` of the squared gradient norm: the canonical second-order
+    program (what a curvature-aware learned attacker or an auto-tuned
+    client optimizer dispatches)."""
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.grad(objective)
+
+    def grad_norm(*args):
+        cotangent = g(*args)
+        sq = jax.tree.map(lambda x: jnp.sum(x * x), cotangent)
+        return 0.5 * jax.tree.reduce(lambda a, b: a + b, sq)
+
+    return jax.grad(grad_norm)
+
+
+def audit_jaxpr_program(name: str, executor: str, raw: Callable,
+                        args: tuple,
+                        expected_collectives: frozenset[str] = frozenset(),
+                        ) -> ProgramReport:
+    """Trace-only audit: sync-freedom, dtype discipline and the
+    collective table from the jaxpr alone — no lowering, no compile (the
+    double-backward and mesh-grad paths, where tracing already proves
+    what we need and lowering would only burn minutes)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(raw)(*args)
+    counts = walk_jaxpr(jaxpr)
+    forbidden = forbidden_primitives(counts)
+    collectives = collective_primitives(counts)
+    f64 = wide_dtype_outputs(jaxpr)
+    report = ProgramReport(
+        name=name, executor=executor,
+        eqns=sum(counts.values()), distinct_primitives=len(counts),
+        forbidden=forbidden, donated_args=(), donated_leaves=0,
+        expected_aliases=0, aliased_leaves=0, f64_outputs=f64,
+        collectives=collectives,
+        expected_collectives=sorted(expected_collectives))
+    if forbidden:
+        report.problems.append(
+            f"forbidden host-transfer primitive(s) in a grad program: "
+            f"{', '.join(forbidden)}")
+    if set(collectives) != set(expected_collectives):
+        report.problems.append(
+            f"grad collective set mismatch: program contains "
+            f"[{', '.join(collectives) or 'none'}], expected "
+            f"[{', '.join(sorted(expected_collectives)) or 'none'}] "
+            "(the `grad` column of EXPECTED_COLLECTIVES — transposition "
+            "duals, see parallel/shard.grad_collectives)")
+    if f64 > 0:
+        report.problems.append(
+            f"{f64} float64/complex128 value(s) in the grad program — "
+            "unexpected wide-dtype promotion under AD")
+    return report
+
+
+def audit_grad_programs(modes: tuple[str, ...] = GRAD_MODES
+                        ) -> list[ProgramReport]:
+    """For each representative defense: the full audit of
+    ``grad(damage)`` for every executor path the engine exposes (sync
+    round->aggregate, fused 2-round chunk), donation aliasing included,
+    plus the jaxpr-level audit of the double-backward program."""
+    import jax
+
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.training.engine import Simulator
+
+    reports: list[ProgramReport] = []
+    for mode in modes:
+        cfg = audit_config(mode=mode)
+        sim = Simulator(cfg)
+        try:
+            for entry in sim.damage_objective():
+                g = jax.grad(entry["objective"])
+                reports.append(audit_program(
+                    f"{mode}:grad[{entry['name']}]", entry["executor"],
+                    g, _jit_donating(g, entry["donate"]),
+                    entry["args"], entry["donate"]))
+                gg = double_backward(entry["objective"])
+                reports.append(audit_jaxpr_program(
+                    f"{mode}:grad2[{entry['name']}]", entry["executor"],
+                    gg, entry["args"]))
+        finally:
+            sim.close()
+    return reports
+
+
+def audit_grad_collectives(modes: tuple[str, ...] = GRAD_MODES
+                           ) -> list[ProgramReport]:
+    """The mesh half: trace ``grad(damage)`` through each defense's
+    shard_map'd aggregation chain and assert exactly the transposed
+    collective set the ``grad`` column of EXPECTED_COLLECTIVES allows.
+    Jaxpr-only (collectives are trace-time structure), so this stays in
+    the tier-1 budget even though sharded *compiles* don't."""
+    import jax
+    import jax.numpy as jnp
+
+    from attackfl_tpu.config import audit_config
+    from attackfl_tpu.data.synthetic import get_dataset
+    from attackfl_tpu.parallel.mesh import make_client_mesh
+    from attackfl_tpu.registry import get_model
+    from attackfl_tpu.training.round import build_aggregator
+
+    ndev = len(jax.devices())
+    cfg0 = audit_config(prng_impl="threefry2x32", total_clients=2 * ndev)
+    model = get_model(cfg0.model)
+    test_np = get_dataset(cfg0.data_name, "test", cfg0.test_size,
+                          cfg0.random_seed)
+    mesh = make_client_mesh()
+    n = cfg0.total_clients
+    rng = jax.random.key(0, impl="threefry2x32")
+    params = model.init(rng, jnp.zeros((1, 7)),
+                        jnp.zeros((1, 16)))["params"]
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), params)
+    sizes = jnp.ones((n,), jnp.int32)
+    wmask = jnp.ones((n,), jnp.float32)
+    perturb = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), stacked)
+
+    def make_damage(agg):
+        def damage(perturb, params, stacked, sizes, wmask, rng):
+            poisoned = jax.tree.map(lambda s, p: s + p, stacked, perturb)
+            new = agg(params, poisoned, sizes, wmask, rng)
+            sq = jax.tree.map(lambda a, b: jnp.sum((a - b) ** 2),
+                              new, params)
+            return jax.tree.reduce(lambda a, b: a + b, sq)
+        return damage
+
+    reports: list[ProgramReport] = []
+    for mode in modes:
+        agg = build_aggregator(model, cfg0.replace(mode=mode), test_np,
+                               mesh=mesh)
+        g = jax.grad(make_damage(agg))
+        reports.append(audit_jaxpr_program(
+            f"sharded-{mode}[{ndev}dev]:grad[aggregate]", "sync", g,
+            (perturb, params, stacked, sizes, wmask, rng),
+            expected_collectives=EXPECTED_COLLECTIVES[mode]["grad"]))
+    return reports
+
+
+def grad_report(modes: tuple[str, ...] = GRAD_MODES,
+                dataflow_modes: tuple[str, ...] | None = None
+                ) -> dict[str, Any]:
+    """The full transform-safety document: grad/double-backward program
+    reports (sync + fused + mesh collectives) and the per-defense
+    differentiability dataflow table.  Committed as
+    ``tests/data/grad_audit_report.json`` via scripts/regen_goldens.py;
+    the ``--grad`` audit rebuilds it live."""
+    from attackfl_tpu.analysis import dataflow
+
+    programs = audit_grad_programs(modes) + audit_grad_collectives(modes)
+    reports = dataflow.defense_dataflow_reports(dataflow_modes)
+    findings = dataflow.defense_findings(reports)
+    return {
+        "grad_modes": list(modes),
+        "programs": [p.to_dict() for p in programs],
+        "dataflow": [r.to_dict() for r in reports],
+        "ok": (not findings) and all(p.ok for p in programs),
+    }

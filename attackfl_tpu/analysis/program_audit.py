@@ -44,6 +44,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from attackfl_tpu.analysis.findings import Finding
+from attackfl_tpu.analysis.registry import register_info
 
 # Primitives that fence or transfer; "callback" as a substring catches the
 # whole jax callback family (pure_callback, io_callback, debug_callback)
@@ -60,24 +61,41 @@ COLLECTIVE_PRIMITIVES = frozenset({
     "reduce_scatter", "pbroadcast", "psum_invariant",
 })
 
-# defense mode -> the exact collective set its sharded aggregation chain
-# may use (parallel/shard.shard_aggregator's design table): partial-sum
-# defenses reduce with psum only; order-statistic/pairwise/quantile/
-# anchor defenses reassemble the full client matrix with all_gather and
-# nothing else.  Training itself (shard_local_update) is collective-free
-# by construction, so these sets describe the WHOLE round program.
-EXPECTED_COLLECTIVES: dict[str, frozenset[str]] = {
-    "fedavg": frozenset({"psum"}),
-    "fltracer": frozenset({"psum"}),
-    "gmm": frozenset({"psum"}),
-    "shieldfl": frozenset({"psum"}),
-    "FLTrust": frozenset({"psum"}),
-    "median": frozenset({"all_gather"}),
-    "trimmed_mean": frozenset({"all_gather"}),
-    "krum": frozenset({"all_gather"}),
-    "scionfl": frozenset({"all_gather"}),
-    "byzantine": frozenset({"all_gather"}),
+# defense mode -> the exact collective sets its sharded aggregation
+# chain may use, per transform (parallel/shard.shard_aggregator's design
+# table): the "forward" column is the round program as dispatched —
+# partial-sum defenses reduce with psum only; order-statistic/pairwise/
+# quantile/anchor defenses reassemble the full client matrix with
+# all_gather and nothing else.  The "grad" column (ISSUE 20) is the
+# grad-transformed program: AD transposes each collective into its dual
+# (psum is self-dual; all_gather's cotangent is a reduce_scatter, plus
+# the re-forwarded gather and a psum over replicated residuals — see
+# parallel/shard.grad_collectives).  Training itself (shard_local_update)
+# is collective-free by construction, so these sets describe the WHOLE
+# round program under either transform.
+_PSUM_FWD = frozenset({"psum"})
+_GATHER_FWD = frozenset({"all_gather"})
+_PSUM_GRAD = frozenset({"psum"})
+_GATHER_GRAD = frozenset({"all_gather", "psum", "reduce_scatter"})
+EXPECTED_COLLECTIVES: dict[str, dict[str, frozenset[str]]] = {
+    "fedavg": {"forward": _PSUM_FWD, "grad": _PSUM_GRAD},
+    "fltracer": {"forward": _PSUM_FWD, "grad": _PSUM_GRAD},
+    "gmm": {"forward": _PSUM_FWD, "grad": _PSUM_GRAD},
+    "shieldfl": {"forward": _PSUM_FWD, "grad": _PSUM_GRAD},
+    "FLTrust": {"forward": _PSUM_FWD, "grad": _PSUM_GRAD},
+    "median": {"forward": _GATHER_FWD, "grad": _GATHER_GRAD},
+    "trimmed_mean": {"forward": _GATHER_FWD, "grad": _GATHER_GRAD},
+    "krum": {"forward": _GATHER_FWD, "grad": _GATHER_GRAD},
+    "scionfl": {"forward": _GATHER_FWD, "grad": _GATHER_GRAD},
+    "byzantine": {"forward": _GATHER_FWD, "grad": _GATHER_GRAD},
 }
+
+
+def expected_collectives(mode: str, transform: str = "forward"
+                         ) -> frozenset[str]:
+    """The :data:`EXPECTED_COLLECTIVES` entry for one defense under one
+    transform (``"forward"`` or ``"grad"``)."""
+    return EXPECTED_COLLECTIVES[mode][transform]
 
 FORBIDDEN_HINT = (
     "host work must live in the engine's audited resolve points (see the "
@@ -89,6 +107,15 @@ DONATION_AUDIT_HINT = (
 F64_HINT = (
     "keep round math in f32/bf16: find the promotion (np.float64 scalar, "
     "Python float in a jnp op under x64) and cast it explicitly")
+
+register_info(
+    "program-audit",
+    "every jitted round program (sync/fused/pipelined/matrix, sharded "
+    "included) is sync-free, f64-free, donation-aliased as declared by "
+    "Simulator.donation_spec(), and carries exactly its defense's "
+    "expected collective set",
+    FORBIDDEN_HINT,
+)
 
 
 def _iter_subjaxprs(value: Any):
@@ -336,7 +363,7 @@ def audit_sharded_programs(modes: tuple[str, ...] = ("fedavg", "median",
     ndev = len(jax.devices())
     reports: list[ProgramReport] = []
     for mode in modes:
-        expected = EXPECTED_COLLECTIVES[mode]
+        expected = EXPECTED_COLLECTIVES[mode]["forward"]
         cfg = audit_config(mode=mode, prng_impl="threefry2x32",
                            total_clients=2 * ndev)
         sim = Simulator(cfg, use_mesh=True)
@@ -419,9 +446,11 @@ def audit_matrix_program() -> list[ProgramReport]:
         runner.close()
 
 
-def reports_to_findings(reports: list[ProgramReport]) -> list[Finding]:
-    """Program-level problems as findings (rule ``program-audit``; the
-    'file' is the program name — there is no single source line)."""
+def reports_to_findings(reports: list[ProgramReport],
+                        rule: str = "program-audit") -> list[Finding]:
+    """Program-level problems as findings (rule ``program-audit``, or
+    ``grad-audit`` for grad-transformed programs; the 'file' is the
+    program name — there is no single source line)."""
     findings = []
     for report in reports:
         for problem in report.problems:
@@ -431,7 +460,7 @@ def reports_to_findings(reports: list[ProgramReport]) -> list[Finding]:
             elif "float64" in problem:
                 hint = F64_HINT
             findings.append(Finding(
-                rule="program-audit", file=f"<program:{report.name}>",
+                rule=rule, file=f"<program:{report.name}>",
                 line=0, message=problem, hint=hint))
     return findings
 

@@ -65,6 +65,12 @@ class Rule:
 
 
 RULES: dict[str, Rule] = {}
+# Passes that run OUTSIDE run_rules (the jaxpr/HLO program auditor, the
+# grad/dataflow transform-safety passes — they need jax and trace real
+# programs, so the CLI drives them behind --skip-programs/--skip-grad
+# gates) still declare their rule ids here so describe_rules() and the
+# report's rule table document every id a Finding can carry.
+INFO_RULES: dict[str, Rule] = {}
 
 
 def register(rule_id: str, description: str, fix_hint: str):
@@ -78,9 +84,29 @@ def register(rule_id: str, description: str, fix_hint: str):
     return deco
 
 
+def register_info(rule_id: str, description: str, fix_hint: str) -> None:
+    """Document a rule id whose pass runs outside :func:`run_rules`
+    (program/grad auditors).  Idempotent re-registration with identical
+    docs is allowed (modules re-import); a conflicting id is an error."""
+    existing = INFO_RULES.get(rule_id)
+    if existing is not None:
+        if (existing.description, existing.fix_hint) != (description,
+                                                         fix_hint):
+            raise ValueError(f"conflicting info rule id {rule_id!r}")
+        return
+    if rule_id in RULES:
+        raise ValueError(f"duplicate rule id {rule_id!r}")
+    INFO_RULES[rule_id] = Rule(rule_id, description, fix_hint,
+                               lambda ctx: [])
+
+
 def load_rules() -> dict[str, Rule]:
-    """Import every rule module (idempotent) and return the registry."""
-    from attackfl_tpu.analysis import artifacts, ast_rules  # noqa: F401
+    """Import every rule module (idempotent) and return the registry.
+    The program/grad/dataflow modules only *document* their ids here
+    (register_info) — their passes import jax lazily, so this stays
+    cheap enough for --skip-programs runs."""
+    from attackfl_tpu.analysis import (  # noqa: F401
+        artifacts, ast_rules, dataflow, grad_audit, program_audit)
 
     return RULES
 
@@ -101,7 +127,12 @@ def run_rules(ctx: AuditContext | None = None,
 
 
 def describe_rules() -> list[dict[str, str]]:
-    """Machine-readable rule table for the report / README."""
+    """Machine-readable rule table for the report / README: the AST/
+    artifact rules run_rules drives plus the documented program/grad
+    pass ids (:func:`register_info`)."""
+    load_rules()
+    merged = dict(RULES)
+    merged.update(INFO_RULES)
     return [{"id": r.rule_id, "description": r.description,
              "fix_hint": r.fix_hint}
-            for _, r in sorted(load_rules().items())]
+            for _, r in sorted(merged.items())]

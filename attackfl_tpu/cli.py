@@ -641,9 +641,12 @@ def watch_main(argv=None) -> int:
 def audit_main(argv=None) -> int:
     """``attackfl-tpu audit``: the static-analysis subsystem — AST rules
     (host-sync, donation-after-use, retrace-hazard, emit-kind), committed
-    event-artifact schema validation, and the jaxpr/HLO program auditor
+    event-artifact schema validation, the jaxpr/HLO program auditor
     (sync-freedom, donation aliasing, dtype discipline) over the three
-    round executors.  ``--json`` for the machine-readable report."""
+    round executors, and the transform-safety auditor (``--grad``):
+    grad/double-backward programs of the post-defense damage objective
+    plus the per-defense differentiability dataflow table.  ``--json``
+    for the machine-readable report."""
     from attackfl_tpu.analysis.cli import audit_main as _audit_main
 
     return _audit_main(list(sys.argv[1:] if argv is None else argv))
@@ -763,7 +766,8 @@ commands:
            --numerics: in-graph device-side round metrics)
   watch    poll a live run's monitor endpoint (/last-round, /healthz)
   audit    static analysis: AST rules + event-schema artifacts + jaxpr/HLO
-           program invariants (--json for the machine-readable report)
+           program invariants + grad/differentiability audit (--grad;
+           --json for the machine-readable report)
   ledger   persistent cross-run store: list/show records, compare two runs
            (perf + numerics + forensics columns), regress = CI gate with
            noise-aware thresholds, import = backfill BENCH_*.json

@@ -64,6 +64,29 @@ PSUM_MODES = frozenset({"fedavg", "fltracer", "gmm", "shieldfl", "FLTrust"})
 GATHER_MODES = frozenset({"median", "trimmed_mean", "krum", "scionfl",
                           "byzantine"})
 
+# AD transposes collectives (ISSUE 20): differentiating a shard_map'd
+# aggregation chain rewrites each collective into its transposition dual.
+# `psum` is self-dual (the cotangent of a cross-shard sum is a broadcast,
+# which replicated-cotangent accounting keeps as a psum), while the
+# cotangent of an `all_gather` is a `reduce_scatter` — and the grad
+# program re-runs the forward gather for its residuals, so a gather
+# defense's grad carries {all_gather, psum, reduce_scatter}.  Measured on
+# the traced grad programs; asserted by the `grad` column of
+# :data:`attackfl_tpu.analysis.program_audit.EXPECTED_COLLECTIVES`.
+_GRAD_COLLECTIVE_DUALS: dict[str, frozenset[str]] = {
+    "psum": frozenset({"psum"}),
+    "all_gather": frozenset({"all_gather", "psum", "reduce_scatter"}),
+}
+
+
+def grad_collectives(forward: frozenset[str]) -> frozenset[str]:
+    """The collective set a grad-transformed round program may contain,
+    derived from its forward set via the transposition duals above."""
+    out: set[str] = set()
+    for name in forward:
+        out |= _GRAD_COLLECTIVE_DUALS.get(name, frozenset({name}))
+    return frozenset(out)
+
 
 def supports_shard_map(cfg) -> bool:
     """True when this config's mesh execution may use shard_map: plain

@@ -703,6 +703,75 @@ class Simulator:
                 args=(state,), donate=spec["pipeline_step"]))
         return programs
 
+    def damage_objective(self, state: dict[str, Any] | None = None
+                         ) -> list[dict[str, Any]]:
+        """Scalar post-defense damage objectives for the transform-safety
+        auditor (ISSUE 20): ``{name, executor, objective, args, donate}``
+        per executor path.  Each ``objective(perturb, ...) -> scalar``
+        measures how far the defended aggregate moves under an additive
+        perturbation of the attackers' stacked deltas (sync) or of the
+        leaked-genuine pool the attack templates read (fused) — the thing
+        a learned adversary would ascend.  ``jax.grad`` of these is what
+        grad_audit traces/lowers; nothing here executes.  Donating the
+        perturbation (argnum 0) is aliasable 1:1: the gradient output has
+        the perturbation's exact tree."""
+        if self.is_hyper:
+            raise NotImplementedError(
+                "hyper mode has no attack-perturbation damage objective "
+                "(no per-client aggregate to perturb)")
+        state = self._canonical_device_state(self._ensure_numerics_state(
+            state if state is not None else self.init_state()))
+        _, k_round, k_agg = jax.random.split(state["rng"], 3)
+        b = jnp.asarray(1)
+        args = (state["global_params"], state["prev_genuine"],
+                state["have_genuine"], k_round, b)
+        stacked_sd, *_ = jax.eval_shape(self._round_step_raw, *args)
+        attacker_sel = jnp.asarray(self.attacker_mask, jnp.float32)
+        wmask = jnp.ones((self.cfg.total_clients,), jnp.float32)
+        round_step_raw = self._round_step_raw
+        aggregate_raw = self._aggregate_raw
+
+        def sync_damage(perturb, global_params, prev_genuine,
+                        have_genuine, rng, broadcast_number, agg_rng):
+            stacked, sizes, _, _, _ = round_step_raw(
+                global_params, prev_genuine, have_genuine, rng,
+                broadcast_number)
+            stacked = jax.tree.map(
+                lambda s, p: s + p * attacker_sel.reshape(
+                    (-1,) + (1,) * (s.ndim - 1)),
+                stacked, perturb)
+            new_global = aggregate_raw(global_params, stacked, sizes,
+                                       wmask * (sizes > 0), agg_rng)
+            sq = jax.tree.map(lambda n, g: jnp.sum((n - g) ** 2),
+                              new_global, global_params)
+            return jax.tree.reduce(lambda a, c: a + c, sq)
+
+        perturb = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), stacked_sd)
+        entries: list[dict[str, Any]] = [dict(
+            name="sync_damage", executor="sync", objective=sync_damage,
+            args=(perturb,) + args + (k_agg,), donate=(0,))]
+        if self.supports_fused():
+            body = self._build_fused_body()
+
+            def fused_damage(pool_perturb, scan_state):
+                s = dict(scan_state)
+                s["prev_genuine"] = jax.tree.map(
+                    lambda a, p: a + p, s["prev_genuine"], pool_perturb)
+                out, _ = jax.lax.scan(body, s, None, length=2)
+                sq = jax.tree.map(lambda n, g: jnp.sum((n - g) ** 2),
+                                  out["global_params"],
+                                  scan_state["global_params"])
+                return jax.tree.reduce(lambda a, c: a + c, sq)
+
+            pool = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+                state["prev_genuine"])
+            entries.append(dict(
+                name="fused_damage[2]", executor="fused",
+                objective=fused_damage, args=(pool, state), donate=(0,)))
+        return entries
+
     # ------------------------------------------------------------------
     # cost observatory (attackfl_tpu/costmodel — ISSUE 11)
     # ------------------------------------------------------------------

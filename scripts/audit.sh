@@ -1,11 +1,14 @@
 #!/usr/bin/env bash
 # One-shot static-analysis gate (ISSUE 5 satellite): the full audit — AST
 # rules (host-sync, donation-after-use, retrace-hazard, emit-kind),
-# committed event-artifact schema validation, and the jaxpr/HLO program
-# auditor over the sync/fused/pipelined executors — plus the two legacy
-# lint entry points (now shims over attackfl_tpu/analysis, kept here so
-# this script fails if the shims rot).  Used by tier-1 through
-# tests/test_audit.py; run it directly before sending a PR.
+# committed event-artifact schema validation, the jaxpr/HLO program
+# auditor over the sync/fused/pipelined executors, and the transform-
+# safety auditor (--grad: grad/double-backward damage-objective programs
+# + the per-defense differentiability table, ISSUE 20) — plus the two
+# legacy lint entry points (now shims over attackfl_tpu/analysis, kept
+# here so this script fails if the shims rot).  Used by tier-1 through
+# tests/test_audit.py (as `audit.sh --skip-sharded`, i.e. `audit --grad
+# --skip-sharded`); run it directly before sending a PR.
 #
 # Usage: scripts/audit.sh [extra `attackfl-tpu audit` args, e.g. --json]
 set -euo pipefail
@@ -14,6 +17,6 @@ cd "$(dirname "$0")/.."
 # one (the invariants are structural — identical on CPU and TPU)
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-python -m attackfl_tpu audit "$@"
+python -m attackfl_tpu audit --grad "$@"
 python scripts/check_event_schema.py
 python scripts/check_host_sync.py

@@ -26,13 +26,13 @@ sys.path.insert(0, str(REPO))
 
 from attackfl_tpu.analysis.ast_rules import (  # noqa: E402
     ALLOWED_FUNCTIONS,
-    NUMERICS_FILES,
-    TRAINING,
+    HOST_SIDE,
+    TRACED_ONLY,
     host_sync_check_file as check_file,
     host_sync_main as main,
 )
 
-__all__ = ["ALLOWED_FUNCTIONS", "NUMERICS_FILES", "TRAINING",
+__all__ = ["ALLOWED_FUNCTIONS", "HOST_SIDE", "TRACED_ONLY",
            "check_file", "main"]
 
 if __name__ == "__main__":

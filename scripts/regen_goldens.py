@@ -1,0 +1,64 @@
+"""Regenerate the committed audit golden corpus (ISSUE 20 satellite).
+
+Writes, deterministically (no timestamps, repo-relative paths, pinned
+device count so sharded program names don't depend on the host):
+
+* ``tests/data/audit_report.json`` — the full ``attackfl-tpu audit
+  --json`` report: AST/artifact rules, forward program audits (sharded
+  included, 8 pinned CPU devices), grad/double-backward program audits
+  and the per-defense differentiability dataflow table.
+* ``tests/data/grad_audit_report.json`` — the standalone transform-safety
+  document (:func:`attackfl_tpu.analysis.grad_audit.grad_report`).
+
+Tests assert STRUCTURE against these goldens (keys, schema version,
+program names, verdicts), never bytes — regeneration after an intentional
+format change is expected, silent drift is not.
+
+Usage: python scripts/regen_goldens.py   (takes minutes: the sharded
+donation checks compile the mesh programs on CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# pin the backend BEFORE jax imports: the goldens' sharded program names
+# embed the device count (e.g. "sharded-fedavg[8dev]:fused[4]")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    from attackfl_tpu.analysis.cli import build_report
+    from attackfl_tpu.analysis.grad_audit import grad_report
+
+    out = REPO / "tests" / "data"
+    report = build_report()
+    path = out / "audit_report.json"
+    path.write_text(json.dumps(report, indent=2) + "\n")
+    print(f"wrote {path} ({len(report['programs'])} programs, "
+          f"{len(report['grad_programs'])} grad programs, "
+          f"{len(report['dataflow'])} dataflow verdicts, "
+          f"ok={report['ok']})")
+
+    greport = grad_report()
+    gpath = out / "grad_audit_report.json"
+    gpath.write_text(json.dumps(greport, indent=2) + "\n")
+    print(f"wrote {gpath} ({len(greport['programs'])} programs, "
+          f"{len(greport['dataflow'])} dataflow verdicts, "
+          f"ok={greport['ok']})")
+    return 0 if report["ok"] and greport["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

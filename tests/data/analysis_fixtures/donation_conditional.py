@@ -1,0 +1,27 @@
+"""Seeded donation-after-use violations for the CONDITIONAL donation
+idiom (`(1,) if donate else ()` — the engine/matrix numerics-aware
+policy).  Line numbers are asserted exactly in tests/test_analysis.py."""
+
+import jax
+
+
+def unguarded_read(p, s, donate):
+    agg = jax.jit(lambda p, s: p, donate_argnums=(1,) if donate else ())
+    out = agg(p, s)
+    return out, s.sum()  # line 11: read in BOTH configurations — flagged
+
+
+def guarded_read(p, s, numerics_on):
+    safe_agg = jax.jit(lambda p, s: p,
+                       donate_argnums=() if numerics_on else (1,))
+    out = safe_agg(p, s)
+    if numerics_on:  # correlated with the non-donating branch — exempt
+        return out, s.sum()
+    return out, None
+
+
+def direct_form(p, s, donate):
+    out = jax.jit(lambda p, s: p,
+                  donate_argnums=(1,) if donate else ())(p, s)
+    total = s.sum()  # line 26: unguarded, direct jax.jit(...)(...) form
+    return out, total

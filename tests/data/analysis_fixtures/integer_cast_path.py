@@ -1,0 +1,16 @@
+"""Seeded differentiability violation: the perturbation is quantized
+through an integer dtype on its only path to the objective — the
+round-trip cast has zero derivative everywhere, flattening the damage
+objective.  Line numbers are asserted exactly in tests/test_analysis.py."""
+
+import jax.numpy as jnp
+
+
+def objective(perturb, target):
+    quantized = perturb.astype(jnp.int32)  # line 10: cliff (f32 -> i32)
+    return jnp.sum((quantized.astype(jnp.float32) - target) ** 2)
+
+
+def example_args():
+    return (jnp.ones((4,), jnp.float32) * 2.5,
+            jnp.zeros((4,), jnp.float32))

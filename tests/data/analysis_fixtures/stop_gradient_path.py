@@ -1,0 +1,16 @@
+"""Seeded differentiability violation: the attack perturbation only
+reaches the objective through ``stop_gradient``, so ``jax.grad`` returns
+exact zeros and a learned attacker would silently train on noise.  Line
+numbers are asserted exactly in tests/test_analysis.py."""
+
+import jax
+import jax.numpy as jnp
+
+
+def objective(perturb, target):
+    poisoned = jax.lax.stop_gradient(perturb) + target  # line 11: cliff
+    return jnp.sum((poisoned - target) ** 2)
+
+
+def example_args():
+    return (jnp.ones((4,), jnp.float32), jnp.zeros((4,), jnp.float32))

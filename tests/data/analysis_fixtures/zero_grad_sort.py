@@ -1,0 +1,19 @@
+"""Seeded differentiability violation: the perturbation only influences
+the objective through order statistics' *indices* (argsort/argmin) —
+integer outputs with zero derivative, so the objective is flat in the
+attack params even though its value visibly depends on them.  Line
+numbers are asserted exactly in tests/test_analysis.py."""
+
+import jax.numpy as jnp
+
+
+def objective(perturb, scores):
+    order = jnp.argsort(perturb)  # line 11: cliff (index output)
+    best = jnp.argmin(perturb)  # line 12: cliff (index output)
+    picked = scores[order[0]] + scores[best]
+    return jnp.sum(picked.astype(jnp.float32))
+
+
+def example_args():
+    return (jnp.arange(4, dtype=jnp.float32),
+            jnp.ones((4,), jnp.float32))

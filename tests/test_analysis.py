@@ -64,19 +64,41 @@ def test_donation_after_use_fixture():
     assert not any(f.line in range(17, 21) for f in findings)
 
 
-def test_donation_conditional_argnums_not_tracked(tmp_path):
-    """The engine's conditional donation (`() if numerics else (1,)`) is a
-    host-level decision — the AST rule must not false-positive on it (the
-    jaxpr auditor covers the actual aliasing)."""
+def test_donation_conditional_argnums_tracked():
+    """ISSUE 20 satellite: the conditional-literal donation idiom
+    (`(1,) if donate else ()`) IS tracked — an unguarded later read is
+    flagged (wrong in whichever configuration donates), a read inside an
+    `if` is assumed correlated with the non-donating branch and exempt."""
+    findings = donation_after_use_findings(
+        FIXTURES / "donation_conditional.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("donation-after-use", 11), ("donation-after-use", 26)]
+    assert "conditionally donated" in findings[0].message
+    assert "unguarded" in findings[0].message
+    assert "`s`" in findings[0].message
+    # guarded_read (lines 14-20) produces nothing
+    assert not any(14 <= f.line <= 20 for f in findings)
+
+
+def test_donation_conditional_engine_idiom_unflagged(tmp_path):
+    """The engine's real shape — a guarded numerics read after the
+    conditionally-donating dispatch — stays green, and computed argnums
+    (donation_spec() subscripts) stay untracked as before."""
     path = tmp_path / "engine_like.py"
     path.write_text(
         "import jax\n"
         "class S:\n"
-        "    def build(self, on):\n"
-        "        self.agg = jax.jit(lambda p, s: p,\n"
-        "                           donate_argnums=() if on else (1,))\n"
-        "    def round(self, p, s):\n"
-        "        out = self.agg(p, s)\n"
+        "    def round(self, p, s, on):\n"
+        "        agg = jax.jit(lambda p, s: p,\n"
+        "                      donate_argnums=() if on else (1,))\n"
+        "        out = agg(p, s)\n"
+        "        if self.numerics is not None:\n"
+        "            self.numerics.push(s.sum())\n"
+        "        return out\n"
+        "    def computed(self, p, s):\n"
+        "        agg = jax.jit(lambda p, s: p,\n"
+        "                      donate_argnums=self.spec()['agg'])\n"
+        "        out = agg(p, s)\n"
         "        return out, s.sum()\n")
     assert donation_after_use_findings(path) == []
 
@@ -147,6 +169,55 @@ def test_allowlist_drift_fails_with_clear_message(monkeypatch):
         lint.ALLOWED_FUNCTIONS, "engine.py",
         set(lint.ALLOWED_FUNCTIONS["engine.py"]) | {"Simulator._renamed_away"})
     assert lint.main([]) == 1
+
+
+def test_host_sync_discovery_covers_every_package():
+    """ISSUE 20 satellite: the linted file set is discovered, not
+    hand-maintained.  Every source under attackfl_tpu/ classifies, and the
+    packages that historically trailed the old per-PR lists (science/,
+    scheduler/, costmodel/, profiler/) are all covered — a NEW file in any
+    of them is classified by its directory prefix, never silently skipped."""
+    from attackfl_tpu.analysis import ast_rules
+
+    traced, coverage = ast_rules.host_sync_coverage()
+    assert coverage == [], "\n".join(f.format() for f in coverage)
+    rels = {p.relative_to(ast_rules.PACKAGE).as_posix() for p in traced}
+    # linted packages actually contribute files to the traced-only set
+    for pkg in ("training/", "costmodel/", "profiler/", "analysis/",
+                "matrix/", "service/", "faults/", "models/"):
+        assert any(r.startswith(pkg) for r in rels), pkg
+    assert "ops/fused_step.py" in rels
+    assert "telemetry/numerics.py" in rels
+    # science/scheduler are explicitly host-side with a documented reason
+    for rel in ("science/rank.py", "scheduler/core.py",
+                "scheduler/pricing.py", "science/outcomes.py"):
+        kind, reason = ast_rules.classify_host_sync(rel)
+        assert kind == "host-side" and reason, rel
+    # ...and a brand-new file in ANY registered package still classifies
+    for pkg in ("science/", "scheduler/", "costmodel/", "profiler/",
+                "training/", "telemetry/"):
+        assert ast_rules.classify_host_sync(pkg + "new_module.py"), pkg
+    # longest-prefix override: file beats its directory's default
+    assert ast_rules.classify_host_sync(
+        "telemetry/numerics.py")[0] == "traced-only"
+    assert ast_rules.classify_host_sync(
+        "telemetry/monitor.py")[0] == "host-side"
+
+
+def test_host_sync_discovery_flags_unclassified_file(tmp_path):
+    """A file outside every registered prefix is itself a finding — the
+    failure mode the registry exists to prevent."""
+    from attackfl_tpu.analysis import ast_rules
+
+    assert ast_rules.classify_host_sync("brand_new_pkg/thing.py") is None
+    pkg = tmp_path / "attackfl_tpu"
+    (pkg / "brand_new_pkg").mkdir(parents=True)
+    (pkg / "brand_new_pkg" / "thing.py").write_text("x = 1\n")
+    traced, coverage = ast_rules.host_sync_coverage(pkg, tmp_path)
+    assert traced == []
+    assert [f.rule for f in coverage] == ["host-sync"]
+    assert "brand_new_pkg/thing.py" in coverage[0].message
+    assert "escape the lint" in coverage[0].message
 
 
 # ---------------------------------------------------------------------------
@@ -330,8 +401,14 @@ def test_expected_collectives_table_matches_traced_aggregates():
         jaxpr = jax.make_jaxpr(agg)(params, stacked, sizes, wmask, rng)
         counts = program_audit.walk_jaxpr(jaxpr)
         got = set(program_audit.collective_primitives(counts))
-        assert got == set(expected), (mode, got, expected)
+        assert got == set(expected["forward"]), (mode, got, expected)
         assert not program_audit.forbidden_primitives(counts), mode
+        # the grad column is exactly the AD-transposition duals of the
+        # forward set (parallel/shard.grad_collectives)
+        from attackfl_tpu.parallel.shard import grad_collectives
+
+        assert set(expected["grad"]) == set(
+            grad_collectives(expected["forward"])), mode
 
 
 @pytest.mark.slow
@@ -365,7 +442,10 @@ def test_audit_report_fast_path_is_clean():
     assert report["findings"] == []
     assert {r["id"] for r in report["rules"]} == {
         "host-sync", "donation-after-use", "retrace-hazard", "emit-kind",
-        "event-schema"}
+        "event-schema", "program-audit", "grad-audit",
+        "grad-stop-gradient", "grad-integer-cast", "grad-zero-path"}
+    # --skip-programs implies no grad/dataflow sections unless forced
+    assert report["grad_programs"] == [] and report["dataflow"] == []
 
 
 def test_golden_report_format():
@@ -376,9 +456,9 @@ def test_golden_report_format():
                          "audit_report.json").read_text())
     fresh = build_report(skip_programs=True)
     assert sorted(golden) == sorted(fresh) == [
-        "findings", "ok", "programs", "rules", "schema", "tool",
-        "transfer_budget"]
-    assert golden["schema"] == fresh["schema"]
+        "dataflow", "findings", "grad_programs", "ok", "programs",
+        "rules", "schema", "tool", "transfer_budget"]
+    assert golden["schema"] == fresh["schema"] == 2
     assert golden["ok"] is True and golden["findings"] == []
     assert {r["id"] for r in golden["rules"]} == {
         r["id"] for r in fresh["rules"]}
@@ -387,10 +467,41 @@ def test_golden_report_format():
                     "forbidden_primitives", "donated_args", "donated_leaves",
                     "expected_aliases", "aliased_leaves", "f64_outputs",
                     "collectives", "expected_collectives", "problems"}
-    for p in golden["programs"]:
+    for p in golden["programs"] + golden["grad_programs"]:
         assert set(p) == program_keys
         assert p["ok"] is True
+    # the transform-safety section is present and covers every exposed
+    # objective per representative defense: grad + double-backward
+    names = {p["name"] for p in golden["grad_programs"]}
+    from attackfl_tpu.analysis.grad_audit import GRAD_MODES
+
+    for mode in GRAD_MODES:
+        assert f"{mode}:grad[sync_damage]" in names
+        assert f"{mode}:grad2[sync_damage]" in names
+        assert any(n.startswith(f"sharded-{mode}[") for n in names), mode
+    assert len(golden["dataflow"]) >= 10
+    for d in golden["dataflow"]:
+        assert d["verdict"] in {"smooth", "piecewise", "partial"}  # no flat
+        assert 0.0 <= d["reachability"] <= 1.0
     assert golden["transfer_budget"]["resolved"] is True
+
+
+def test_grad_golden_report_format():
+    """tests/data/grad_audit_report.json: the standalone transform-safety
+    document scripts/regen_goldens.py commits (structure, not bytes)."""
+    golden = json.loads((REPO / "tests" / "data" /
+                         "grad_audit_report.json").read_text())
+    assert sorted(golden) == ["dataflow", "grad_modes", "ok", "programs"]
+    assert golden["ok"] is True
+    from attackfl_tpu.analysis.grad_audit import GRAD_MODES
+
+    assert golden["grad_modes"] == list(GRAD_MODES)
+    assert all(p["ok"] for p in golden["programs"])
+    # the committed per-defense differentiability table names every mode
+    from attackfl_tpu.parallel.shard import GATHER_MODES, PSUM_MODES
+
+    assert {d["name"] for d in golden["dataflow"]} == {
+        f"defense:{m}" for m in sorted(PSUM_MODES | GATHER_MODES)}
 
 
 def test_audit_cli_exit_codes(capsys):
@@ -403,3 +514,104 @@ def test_audit_cli_exit_codes(capsys):
     assert audit_main(["--skip-programs", "--json"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert payload["ok"] is True
+    with pytest.raises(SystemExit):  # mutually exclusive flags
+        audit_main(["--grad", "--skip-grad"])
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# transform-safety auditor (ISSUE 20): dataflow pass + grad programs
+# ---------------------------------------------------------------------------
+
+
+def test_dataflow_fixture_corpus():
+    """The committed differentiability fixtures each produce their exact
+    rule id + line — the clean-tree dataflow gate is non-vacuous."""
+    from attackfl_tpu.analysis.dataflow import analyze_fixture
+
+    cases = {
+        "stop_gradient_path": [("grad-stop-gradient", 11)],
+        "integer_cast_path": [("grad-integer-cast", 10)],
+        "zero_grad_sort": [("grad-zero-path", 11), ("grad-zero-path", 12)],
+    }
+    for name, expected in cases.items():
+        report, findings = analyze_fixture(FIXTURES / f"{name}.py")
+        assert report.flat, name
+        got = sorted((f.rule, f.line) for f in findings)
+        assert got == expected, (name, got)
+        for f in findings:
+            assert f"analysis_fixtures/{name}.py" in f.file
+            assert "flat in the attack params" in f.message
+
+
+def test_dataflow_defense_table_matches_guidance():
+    """The per-defense gradient-reachability table over the LIVE tree:
+    every defense's damage objective keeps a gradient-carrying path (no
+    flat verdicts — the clean-tree gate), and the verdict classes land
+    where the defense math says they must (order statistics are
+    piecewise, index selection is partial, weighted means are smooth)."""
+    from attackfl_tpu.analysis.dataflow import (
+        defense_dataflow_reports, defense_findings)
+
+    reports = defense_dataflow_reports()
+    assert defense_findings(reports) == [], [
+        r.name for r in reports if r.flat]
+    verdicts = {r.name.removeprefix("defense:"): r.verdict
+                for r in reports}
+    assert verdicts["fedavg"] == "smooth"
+    assert verdicts["median"] == "piecewise"       # sort
+    assert verdicts["trimmed_mean"] == "piecewise"  # sort
+    assert verdicts["FLTrust"] == "piecewise"       # max clipping
+    assert verdicts["krum"] == "partial"            # argmin index cliff
+    for r in reports:
+        assert r.reachability > 0.5, (r.name, r.reachability)
+        assert r.touched_eqns >= r.live_eqns > 0
+
+
+def test_grad_collective_duals():
+    """parallel/shard.grad_collectives: psum is self-dual; all_gather's
+    transpose brings {all_gather, psum, reduce_scatter}.  And the traced
+    grad of the sharded damage objective carries exactly the `grad`
+    column for each representative defense (the mesh half of the
+    transform-safety audit, jaxpr-only)."""
+    from attackfl_tpu.analysis.grad_audit import audit_grad_collectives
+    from attackfl_tpu.parallel.shard import grad_collectives
+
+    assert grad_collectives(frozenset({"psum"})) == frozenset({"psum"})
+    assert grad_collectives(frozenset({"all_gather"})) == frozenset(
+        {"all_gather", "psum", "reduce_scatter"})
+    reports = audit_grad_collectives()
+    assert len(reports) == 3
+    problems = [(r.name, r.problems) for r in reports if not r.ok]
+    assert not problems, problems
+    for r in reports:
+        assert r.collectives, r.name  # the mesh grad really communicates
+
+
+@pytest.mark.slow
+def test_grad_programs_full_audit():
+    """ISSUE 20 acceptance (slow half): grad + double-backward of the
+    damage objective for every representative defense and executor pass
+    the full audit — donation aliasing of the perturbation into its own
+    gradient included — and the mesh grad collective table holds across
+    the ENTIRE defense grid, not just the representative triad."""
+    from attackfl_tpu.analysis import grad_audit
+    from attackfl_tpu.parallel.shard import GATHER_MODES, PSUM_MODES
+
+    reports = grad_audit.audit_grad_programs()
+    problems = [(r.name, r.problems) for r in reports if not r.ok]
+    assert not problems, problems
+    names = {r.name for r in reports}
+    for mode in grad_audit.GRAD_MODES:
+        assert f"{mode}:grad[sync_damage]" in names
+        assert f"{mode}:grad2[sync_damage]" in names
+    # first-order grads donate the perturbation 1:1 into its gradient
+    first_order = [r for r in reports if ":grad[" in r.name]
+    assert first_order and all(
+        r.expected_aliases > 0
+        and r.aliased_leaves == r.expected_aliases for r in first_order)
+    # full grid: every defense's sharded grad matches its dual table
+    grid = grad_audit.audit_grad_collectives(
+        tuple(sorted(PSUM_MODES | GATHER_MODES)))
+    bad = [(r.name, r.problems) for r in grid if not r.ok]
+    assert not bad, bad

@@ -1,7 +1,8 @@
 """Tier-1 wiring for scripts/audit.sh (ISSUE 5 satellite): the one-shot
-audit gate — `attackfl-tpu audit` (AST rules + event-schema + jaxpr/HLO
-program invariants) plus both legacy lint shims — must pass clean on the
-tree, as a subprocess exactly the way CI/developers invoke it."""
+audit gate — `attackfl-tpu audit --grad` (AST rules + event-schema +
+jaxpr/HLO program invariants + the ISSUE 20 transform-safety auditor)
+plus both legacy lint shims — must pass clean on the tree, as a
+subprocess exactly the way CI/developers invoke it."""
 
 import os
 import pathlib
@@ -26,6 +27,16 @@ def test_audit_sh_passes_clean_on_the_tree():
         capture_output=True, text=True, env=env, timeout=480)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 finding(s) — OK" in proc.stdout
+    # the transform-safety auditor ran live (ISSUE 20 acceptance): grad +
+    # double-backward programs for the representative defenses, mesh
+    # collective duals, and the per-defense differentiability table
+    for marker in ("grad program fedavg:grad[sync_damage]",
+                   "grad program median:grad2[",
+                   "grad program FLTrust:grad[",
+                   "grad program sharded-fedavg",
+                   "dataflow defense:krum: partial",
+                   "dataflow defense:fedavg: smooth"):
+        assert marker in proc.stdout, marker
     # both shims ran and reported clean
     assert proc.stdout.count(": OK") >= 2
 

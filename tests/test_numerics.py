@@ -414,9 +414,10 @@ def test_numerics_files_are_linted_by_default_and_clean():
         REPO / "attackfl_tpu" / "ops" / "metrics.py") == []
     assert lint.check_file(
         REPO / "attackfl_tpu" / "telemetry" / "numerics.py") == []
-    # and the default scan actually covers them (not just when named)
-    names = {p.name for p in lint.NUMERICS_FILES}
-    assert names == {"metrics.py", "numerics.py"}
+    # and the default scan actually covers them (not just when named):
+    # the discovery registry classifies both as traced-only
+    assert lint.TRACED_ONLY["ops/metrics.py"]
+    assert lint.TRACED_ONLY["telemetry/numerics.py"]
     # only the drainer's single batched transfer is allowlisted
     assert lint.ALLOWED_FUNCTIONS["numerics.py"] == {"NumericsDrainer.drain"}
     assert "metrics.py" not in lint.ALLOWED_FUNCTIONS
